@@ -1,0 +1,249 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Public API surface mirrors the reference (`python/ray/__init__.py` /
+`python/ray/_private/worker.py`): ``init``, ``@remote``, ``get``, ``put``,
+``wait``, actors, placement groups — plus the TPU-first ML stack in
+``ray_tpu.train`` / ``tune`` / ``data`` / ``serve`` / ``rllib`` and the
+tensor plane in ``ray_tpu.collective`` / ``parallel`` / ``ops``.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu._version import __version__
+from ray_tpu.core import worker as _worker_mod
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, kill
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.worker import (
+    DriverWorker,
+    LocalWorker,
+    clear_worker,
+    global_worker,
+    init_worker,
+    is_initialized,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "ObjectRef", "ActorHandle",
+    "placement_group", "remove_placement_group", "PlacementGroup",
+    "cluster_resources", "available_resources", "nodes", "timeline",
+    "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
+    "GetTimeoutError", "ObjectLostError", "__version__",
+]
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[dict] = None,
+    object_store_memory: Optional[int] = None,
+    local_mode: bool = False,
+    namespace: str = "",
+    ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
+    runtime_env: Optional[dict] = None,
+    configure_logging: bool = True,
+    **kwargs,
+):
+    """Start the runtime (reference: `python/ray/_private/worker.py:1106`)."""
+    if is_initialized():
+        if ignore_reinit_error:
+            return
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(pass ignore_reinit_error=True to allow)")
+    if local_mode:
+        init_worker(LocalWorker())
+        return
+    init_worker(
+        DriverWorker(
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources,
+            object_store_memory=object_store_memory,
+            namespace=namespace,
+        )
+    )
+
+
+def shutdown():
+    if not is_initialized():
+        return
+    w = global_worker()
+    clear_worker()
+    if hasattr(w, "shutdown"):
+        w.shutdown()
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes
+    (reference: `python/ray/_private/worker.py:2923`)."""
+
+    def wrap(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **kwargs)
+        return RemoteFunction(obj, **kwargs)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+    return wrap
+
+
+def method(**options):
+    """Per-method options decorator (e.g. num_returns) — kept for parity;
+    options can also be given at the call site via ``.options()``."""
+
+    def wrap(m):
+        m.__ray_tpu_method_options__ = options
+        return m
+
+    return wrap
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    w = global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout=timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        if not refs:
+            return []
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("get() accepts an ObjectRef or a list of ObjectRefs")
+        return w.get(list(refs), timeout=timeout)
+    raise TypeError(f"get() got {type(refs)}")
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return global_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+    return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort cancel of a pending task (running tasks finish; force-kill
+    of running normal tasks lands with multi-node)."""
+    w = global_worker()
+    if w.mode != "driver":
+        raise NotImplementedError("cancel() from inside tasks")
+
+    def _cancel():
+        raylet = w.raylet
+        tid = ref.id().task_id()
+        entry = raylet._waiting.pop(tid, None)
+        found = entry is not None
+        if entry is not None:
+            spec, missing = entry
+            for oid in missing:
+                s = raylet._dep_index.get(oid)
+                if s:
+                    s.discard(tid)
+        for q in (raylet._ready_queue,):
+            for spec in list(q):
+                if spec.task_id == tid:
+                    q.remove(spec)
+                    found = True
+        if found:
+            from ray_tpu.core.exceptions import TaskError as _TE
+
+            err = _TE("cancelled", "task was cancelled before it ran", None)
+            raylet._object_error(ref.id(), err)
+        return found
+
+    w.raylet.call(_cancel).result()
+
+
+def free(refs: Sequence[ObjectRef]):
+    global_worker().free(list(refs))
+
+
+def cluster_resources() -> dict:
+    w = global_worker()
+    if w.mode == "driver":
+        return dict(w.raylet.resources_total)
+    return {}
+
+
+def available_resources() -> dict:
+    w = global_worker()
+    if w.mode == "driver":
+        return w.raylet.call(lambda: dict(w.raylet.resources_available)).result()
+    return {}
+
+
+def nodes() -> List[dict]:
+    w = global_worker()
+    if w.mode == "driver":
+        snap = w.raylet.call(w.raylet.state_snapshot).result()
+        return [{
+            "NodeID": snap["node_id"],
+            "Alive": True,
+            "Resources": snap["resources_total"],
+        }]
+    return []
+
+
+def timeline(filename: Optional[str] = None):
+    """Dump task state events as chrome://tracing JSON
+    (reference: `python/ray/_private/state.py:416`)."""
+    import json
+
+    w = global_worker()
+    snap = w.raylet.call(w.raylet.state_snapshot).result()
+    events = []
+    starts = {}
+    for ev in snap["events"]:
+        if ev["state"] == "RUNNING":
+            starts[ev["task_id"]] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and ev["task_id"] in starts:
+            s = starts.pop(ev["task_id"])
+            events.append({
+                "cat": "task", "name": s["name"], "ph": "X",
+                "ts": s["time"] * 1e6, "dur": (ev["time"] - s["time"]) * 1e6,
+                "pid": s.get("pid", 0), "tid": s.get("pid", 0),
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+# Convenience namespaced access (lazy imports to keep `import ray_tpu` light).
+def __getattr__(name):
+    if name in ("train", "tune", "data", "serve", "rllib", "collective",
+                "parallel", "ops", "models", "util", "workflow", "dag"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

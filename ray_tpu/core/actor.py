@@ -1,0 +1,171 @@
+"""Actors: class wrapper, handles, methods.
+
+Reference analogues: ``ActorClass`` (`python/ray/actor.py:383`),
+``ActorHandle`` (`:1024`), ``ActorMethod`` (`:98`).  An actor occupies a
+dedicated worker process; method calls are dispatched FIFO by the raylet's
+per-actor queue (`ray_tpu/core/raylet.py`), matching the reference's ordered
+actor scheduling queues (`src/ray/core_worker/transport/actor_scheduling_queue.cc`).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import ActorID, TaskID
+from ray_tpu.core.remote_function import _build_resources, _placement_from_opts
+from ray_tpu.core.task_spec import (
+    ACTOR_CREATION_TASK,
+    ACTOR_TASK,
+    TaskSpec,
+)
+from ray_tpu.core.worker import global_worker
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, **options):
+        self._handle = handle
+        self._method_name = method_name
+        self._options = options
+
+    def options(self, **new_options) -> "ActorMethod":
+        merged = copy.copy(self._options)
+        merged.update(new_options)
+        return ActorMethod(self._handle, self._method_name, **merged)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method_name, args, kwargs,
+                                    self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            f"use '.{self._method_name}.remote()'."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def _invoke(self, method_name, args, kwargs, opts):
+        worker = global_worker()
+        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            kind=ACTOR_TASK,
+            name=f"{self._class_name}.{method_name}",
+            args=out_args,
+            kwargs=out_kwargs,
+            num_returns=opts.get("num_returns", 1),
+            actor_id=self._actor_id,
+            method_name=method_name,
+        )
+        refs = worker.submit_spec(spec)
+        return refs[0] if spec.num_returns == 1 else refs
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = copy.copy(self._options)
+        merged.update(new_options)
+        return ActorClass(self._cls, **merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts) -> ActorHandle:
+        # Reference semantics: actors default to num_cpus=0 (they hold their
+        # resources for life, so a 1-CPU default would starve the node).
+        opts = dict(opts)
+        opts.setdefault("num_cpus", 0)
+        worker = global_worker()
+        fid, blob = worker.register_function(self._cls)
+        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        actor_id = ActorID.from_random()
+        max_restarts = opts.get("max_restarts",
+                                config.actor_max_restarts_default)
+        placement = _placement_from_opts(opts) or {}
+        if opts.get("name"):
+            placement["name"] = opts["name"]
+            placement["namespace"] = opts.get("namespace", "")
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            kind=ACTOR_CREATION_TASK,
+            name=f"{self.__name__}.__init__",
+            function_blob=blob,
+            function_id=fid,
+            args=out_args,
+            kwargs=out_kwargs,
+            num_returns=1,
+            resources=_build_resources(opts),
+            max_restarts=max_restarts,
+            max_concurrency=opts.get("max_concurrency", 1),
+            actor_id=actor_id,
+            runtime_env=opts.get("runtime_env"),
+            placement=placement or None,
+        )
+        worker.submit_spec(spec)
+        return ActorHandle(actor_id, self.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            f"use '{self.__name__}.remote()'."
+        )
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    worker = global_worker()
+    if worker.mode == "driver":
+        raylet = worker.raylet
+
+        def lookup():
+            aid = raylet._named_actors.get((namespace, name))
+            if aid is None:
+                raise ValueError(f"no actor named {name!r}")
+            return aid, raylet._actors[aid].creation_spec
+
+        aid, creation_spec = raylet.call(lookup).result()
+    else:
+        info = worker._request("named_actor", name=name, namespace=namespace)
+        aid, creation_spec = info["actor_id"], info["creation_spec"]
+    return ActorHandle(aid, creation_spec.name.split(".")[0])
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    worker = global_worker()
+    if worker.mode == "driver":
+        worker.raylet.call_async(
+            worker.raylet.kill_actor, actor.actor_id, no_restart
+        )
+    else:
+        raise NotImplementedError("kill() from inside a task: use the driver")

@@ -1,0 +1,109 @@
+"""Typed config/flag registry with environment-variable override.
+
+Mirrors the reference's ``RAY_CONFIG`` macro system
+(`src/ray/common/ray_config_def.h:22`, env override at
+`src/ray/common/ray_config.h:100`): every flag has a type, a default, and can
+be overridden by ``RAY_TPU_<NAME>`` in the environment.  Flags are read at
+process start; ``Config.initialize(overrides)`` applies a dict (the launcher
+serializes driver-side overrides into worker processes this way, like the
+reference serializes its config JSON into every raylet/worker command line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc", "value")
+
+    def __init__(self, name, type_, default, doc):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            self.value = _PARSERS[type_](env)
+        else:
+            self.value = default
+
+
+class _Config:
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+
+    def define(self, name: str, type_: type, default, doc: str = ""):
+        self._flags[name] = _Flag(name, type_, default, doc)
+
+    def initialize(self, overrides: Dict[str, Any]):
+        for k, v in overrides.items():
+            if k in self._flags:
+                self._flags[k].value = self._flags[k].type(v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: f.value for k, f in self._flags.items()}
+
+    def serialize(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def __getattr__(self, name: str):
+        flags = object.__getattribute__(self, "_flags")
+        if name in flags:
+            return flags[name].value
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self._flags[name].value = self._flags[name].type(value)
+
+
+config = _Config()
+
+# --- core runtime -----------------------------------------------------------
+config.define("object_store_memory_mb", int, 512, "Default shm store size.")
+config.define("object_store_fallback_inproc", bool, False,
+              "Force pure-Python object store (no C++ shm).")
+config.define("inline_object_max_bytes", int, 100 * 1024,
+              "Objects at or below this size are returned inline over the "
+              "control socket instead of through the shm store (reference: "
+              "task returns <=100KB are inlined, core_worker.h:988).")
+config.define("num_workers_default", int, 0,
+              "0 = os.cpu_count() capped by num_cpus.")
+config.define("worker_start_timeout_s", float, 30.0, "")
+config.define("task_retry_default", int, 3,
+              "Default max retries for tasks (reference ray_option_utils.py:149).")
+config.define("actor_max_restarts_default", int, 0, "")
+config.define("get_timeout_poll_s", float, 0.01, "")
+config.define("worker_niceness", int, 0, "")
+config.define("log_to_driver", bool, True, "")
+config.define("temp_dir", str, "/tmp/ray_tpu", "Session root directory.")
+config.define("prestart_workers", bool, True,
+              "Start the worker pool eagerly at init (reference raylet "
+              "prestarts workers, main.cc:48).")
+config.define("health_check_period_s", float, 1.0, "")
+config.define("task_event_buffer_size", int, 10000,
+              "Max buffered task state events for the state API.")
+
+# --- tensor plane -----------------------------------------------------------
+config.define("mesh_default_axes", str, "dp,tp", "")
+config.define("enable_pallas", bool, True,
+              "Use Pallas kernels on TPU when shapes allow.")

@@ -1,0 +1,137 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes.
+
+Design notes (vs reference `src/ray/common/id.h`): the reference derives
+ObjectIDs from the owning TaskID plus a return-index so that ownership can be
+recovered from the ID alone.  We keep that property: an ``ObjectID`` is the
+16-byte TaskID of the task that created it concatenated with a 4-byte
+little-endian index.  ``put`` objects use a per-worker synthetic "put task" id.
+
+All IDs are immutable value types backed by ``bytes`` and are cheap to hash,
+compare, and ship over the wire.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+_JOB_ID_SIZE = 4
+_UNIQUE_ID_SIZE = 16
+_OBJECT_INDEX_SIZE = 4
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    SIZE = _UNIQUE_ID_SIZE
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
+            )
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = _JOB_ID_SIZE
+
+
+class NodeID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class ActorID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class PlacementGroupID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class FunctionID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class TaskID(BaseID):
+    SIZE = _UNIQUE_ID_SIZE
+
+
+class ObjectID(BaseID):
+    """TaskID (16 bytes) + return index (4 bytes LE)."""
+
+    SIZE = _UNIQUE_ID_SIZE + _OBJECT_INDEX_SIZE
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack("<I", index))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_UNIQUE_ID_SIZE])
+
+    def return_index(self) -> int:
+        return struct.unpack("<I", self._bytes[_UNIQUE_ID_SIZE:])[0]
+
+
+# Convenient alias matching the public API name.
+ObjectRefID = ObjectID
+
+
+class _PutCounter:
+    """Per-process counter used to mint ObjectIDs for ``put`` calls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._task_id = TaskID.from_random()
+        self._index = 0
+
+    def next_object_id(self) -> ObjectID:
+        with self._lock:
+            self._index += 1
+            if self._index >= 2**32 - 1:
+                self._task_id = TaskID.from_random()
+                self._index = 1
+            return ObjectID.for_task_return(self._task_id, self._index)
+
+
+put_counter = _PutCounter()

@@ -1,0 +1,67 @@
+"""ObjectRef — the distributed future handle.
+
+Reference analogue: ``ray.ObjectRef`` (Cython, `python/ray/includes/object_ref.pxi`).
+Holds only the ObjectID; resolution goes through the per-process worker
+(`ray_tpu.core.worker`).  Refs are picklable and can be passed as task args
+(dependency) or stored inside other objects (borrowing — round 1 keeps the
+owner as the driver, so serializing a ref is just shipping its ID).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "__weakref__")
+
+    def __init__(self, object_id: ObjectID):
+        self._id = object_id
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        from ray_tpu.core import worker as _w
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(_w.global_worker().get([self])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))

@@ -1,0 +1,258 @@
+"""Python client for the native shared-memory object store.
+
+Counterpart of the reference's plasma client
+(`src/ray/object_manager/plasma/client.h:146`) — but with no store server
+process: all metadata lives in the shm mapping itself (see
+`ray_tpu/native/src/object_store.cc` for the design rationale), so create /
+seal / get are lock-protected shm operations, not socket round trips.
+
+Zero-copy: ``get`` deserializes with out-of-band buffers that alias the mmap
+directly; the store pin is released when the returned root object is
+garbage-collected (weakref.finalize).  Known round-1 limitation: if a caller
+extracts a numpy view from the returned object and drops the root, the pin is
+released early and the buffer becomes evictable under memory pressure (the
+mapping itself stays valid, so this can never segfault).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap as _mmap
+import os
+import time
+import weakref
+from typing import Any, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectStoreFullError(RuntimeError):
+    pass
+
+
+class ObjectLostError(RuntimeError):
+    def __init__(self, object_id: ObjectID):
+        super().__init__(
+            f"Object {object_id.hex()} was evicted or never created. "
+            "Lineage-based reconstruction is not yet wired up."
+        )
+        self.object_id = object_id
+
+
+class _StoreStats(ctypes.Structure):
+    _fields_ = [
+        ("capacity", ctypes.c_uint64),
+        ("bytes_in_use", ctypes.c_uint64),
+        ("num_objects", ctypes.c_uint64),
+        ("num_evictions", ctypes.c_uint64),
+    ]
+
+
+def _load_lib():
+    from ray_tpu.native.build import lib_path
+
+    lib = ctypes.CDLL(lib_path())
+    lib.rt_store_init.restype = ctypes.c_int
+    lib.rt_store_init.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.rt_store_attach.restype = ctypes.c_void_p
+    lib.rt_store_attach.argtypes = [ctypes.c_char_p]
+    lib.rt_store_detach.argtypes = [ctypes.c_void_p]
+    lib.rt_create.restype = ctypes.c_int
+    lib.rt_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_seal.restype = ctypes.c_int
+    lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_get.restype = ctypes.c_int
+    lib.rt_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_release.restype = ctypes.c_int
+    lib.rt_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_contains.restype = ctypes.c_int
+    lib.rt_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_delete.restype = ctypes.c_int
+    lib.rt_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_abort.restype = ctypes.c_int
+    lib.rt_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(_StoreStats)]
+    return lib
+
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+def create_store_file(path: str, capacity_bytes: int, table_cap: int = 1 << 16):
+    rc = _get_lib().rt_store_init(path.encode(), capacity_bytes, table_cap)
+    if rc != 0:
+        raise OSError(-rc, f"rt_store_init({path}) failed")
+
+
+class ShmObjectStore:
+    """A client connection (attach) to a shm store file."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lib = _get_lib()
+        self._handle = self._lib.rt_store_attach(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot attach to object store at {path}")
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            self._mmap = _mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mmap)
+
+    # -- raw byte-level API ---------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        rc = self._lib.rt_create(self._handle, object_id.binary(), size, ctypes.byref(off))
+        if rc == -17:  # EEXIST
+            raise FileExistsError(object_id.hex())
+        if rc != 0:
+            raise ObjectStoreFullError(
+                f"cannot allocate {size} bytes for {object_id.hex()} (rc={rc})"
+            )
+        return self._view[off.value : off.value + size]
+
+    def seal(self, object_id: ObjectID):
+        self._lib.rt_seal(self._handle, object_id.binary())
+
+    def release(self, object_id: ObjectID):
+        self._lib.rt_release(self._handle, object_id.binary())
+
+    def abort(self, object_id: ObjectID):
+        self._lib.rt_abort(self._handle, object_id.binary())
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Pin + return buffer view, or None if absent/unsealed."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_get(self._handle, object_id.binary(),
+                              ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view[off.value : off.value + size.value]
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.rt_contains(self._handle, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return self._lib.rt_delete(self._handle, object_id.binary()) == 0
+
+    def stats(self) -> dict:
+        st = _StoreStats()
+        self._lib.rt_stats(self._handle, ctypes.byref(st))
+        return {
+            "capacity": st.capacity,
+            "bytes_in_use": st.bytes_in_use,
+            "num_objects": st.num_objects,
+            "num_evictions": st.num_evictions,
+        }
+
+    # -- object-level API -----------------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, ser: serialization.SerializedObject):
+        buf = self.create(object_id, ser.total_bytes())
+        try:
+            ser.write_into(buf)
+        except BaseException:
+            del buf
+            self.abort(object_id)
+            raise
+        del buf
+        self.seal(object_id)
+        self.release(object_id)
+
+    def put(self, object_id: ObjectID, value: Any):
+        self.put_serialized(object_id, serialization.serialize(value))
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        """Deserialize an object; blocks until sealed (bounded by timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0005
+        while True:
+            buf = self.get_buffer(object_id)
+            if buf is not None:
+                try:
+                    value = serialization.deserialize(buf)
+                except BaseException:
+                    del buf
+                    self.release(object_id)
+                    raise
+                if value is None or isinstance(value, (bool, int, float, str, bytes)):
+                    # Immutable scalars can't alias shm buffers: unpin now.
+                    del buf
+                    self.release(object_id)
+                else:
+                    try:
+                        weakref.finalize(value, self.release, object_id)
+                    except TypeError:
+                        # Containers (tuple/dict/list) aren't weakref-able:
+                        # release now; the mapping stays valid so views can
+                        # never fault, they just become evictable.
+                        del buf
+                        self.release(object_id)
+                return value
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(f"object {object_id.hex()} not ready")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+
+    def close(self):
+        if self._handle:
+            self._view.release()
+            self._mmap.close()
+            self._lib.rt_store_detach(self._handle)
+            self._handle = None
+
+
+class InProcObjectStore:
+    """Pure-Python fallback store (used by local_mode and unit tests)."""
+
+    def __init__(self):
+        self._objects = {}
+
+    def put(self, object_id: ObjectID, value: Any):
+        self._objects[object_id] = serialization.dumps(value)
+
+    def put_serialized(self, object_id, ser):
+        self._objects[object_id] = ser.to_bytes()
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while object_id not in self._objects:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(f"object {object_id.hex()} not ready")
+            time.sleep(0.001)
+        return serialization.loads(self._objects[object_id])
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return self._objects.pop(object_id, None) is not None
+
+    def stats(self) -> dict:
+        return {
+            "capacity": 0,
+            "bytes_in_use": sum(len(v) for v in self._objects.values()),
+            "num_objects": len(self._objects),
+            "num_evictions": 0,
+        }
+
+    def close(self):
+        self._objects.clear()

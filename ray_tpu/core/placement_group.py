@@ -1,0 +1,79 @@
+"""Placement groups (reference: `python/ray/util/placement_group.py:34,139`,
+bundle reservation 2PC at `src/ray/raylet/placement_group_resource_manager.cc`).
+
+Single-node round 1: bundles are resource sub-pools carved out of the node's
+pool atomically on creation; PACK/SPREAD/STRICT_* strategies are recorded and
+become meaningful with multi-node scheduling (ICI-slice-aware packing is the
+TPU analogue of NVLink-island STRICT_PACK — see SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.worker import global_worker
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    def ready(self):
+        import ray_tpu
+
+        return ray_tpu.put(True)
+
+    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+        return True
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id.hex()}, {self.strategy}, {self.bundle_specs})"
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    worker = global_worker()
+    pg_id = PlacementGroupID.from_random()
+    if worker.mode == "driver":
+        ok = worker.raylet.call(
+            worker.raylet.create_pg, pg_id.hex(), bundles, strategy
+        ).result()
+        if not ok:
+            raise ValueError(
+                f"placement group {bundles} exceeds cluster capacity "
+                f"{worker.raylet.resources_total}"
+            )
+    elif worker.mode == "local":
+        pass
+    else:
+        raise NotImplementedError(
+            "placement_group() from inside tasks is not supported yet"
+        )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    worker = global_worker()
+    if worker.mode == "driver":
+        worker.raylet.call(worker.raylet.remove_pg, pg.id.hex()).result()
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    return None

@@ -1,0 +1,64 @@
+"""Control-plane wire protocol: length-prefixed pickled frames over unix
+sockets.
+
+Reference analogue: Ray uses gRPC for worker<->raylet control
+(`node_manager.proto`) and a unix socket with flatbuffers for the local
+raylet connection (`src/ray/raylet/format/node_manager.fbs`).  Single-node
+round 1 uses one unix stream socket per worker; the multi-node transport
+(gRPC across hosts) slots in behind the same message schema.
+
+Message = arbitrary picklable dict with a "t" (type) key.  Types:
+
+driver->worker:
+  task          {spec: TaskSpec, arg_values: {hex: bytes}}   dispatch
+  reply         {rid, ok, value|error}                       response to a request
+  shutdown      {}
+
+worker->driver:
+  register      {pid, worker_id}
+  done          {task_id, ok, inline: {hex: bytes}, stored: [hex], error}
+  submit        {spec}                                       nested submission
+  request       {rid, op, ...}  ops: get / wait / put_inline / kv_get / kv_put /
+                actor_handle / named_actor / submit_sync / log
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Optional
+
+_LEN = struct.Struct("<Q")
+
+
+def send_msg(sock: socket.socket, msg: Any, lock=None):
+    data = pickle.dumps(msg, protocol=5)
+    frame = _LEN.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Any]:
+    header = recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    data = recv_exact(sock, length)
+    if data is None:
+        return None
+    return pickle.loads(data)

@@ -1,0 +1,999 @@
+"""The node manager ("raylet") — scheduler, worker pool, object directory.
+
+Reference analogues, re-designed for a single event-loop thread living inside
+the driver process rather than a separate daemon:
+
+  * ``NodeManager``/``ClusterTaskManager``/``LocalTaskManager``
+    (`src/ray/raylet/node_manager.h:119`, `scheduling/cluster_task_manager.h:42`,
+    `scheduling/local_task_manager.h:58`) → ``Raylet`` event thread: ready
+    queue, dependency-gated dispatch, resource accounting.
+  * ``WorkerPool`` (`src/ray/raylet/worker_pool.h:156`) → profile-keyed pools
+    of subprocess workers, spawned on demand and prestarted.
+  * ``DependencyManager`` (`src/ray/raylet/dependency_manager.h:51`) →
+    ``_dep_index``: tasks wait until every argument object is ready, so a
+    dispatched task never blocks on args.
+  * GCS tables (`src/ray/gcs/gcs_server/`) → in-process dicts: KV store,
+    function table, named actors, node info.  (Multi-node: these move behind
+    the same message schema over gRPC.)
+  * ``GcsActorManager`` (`gcs_actor_manager.cc`) → ``_ActorState`` lifecycle
+    with restart-on-death (max_restarts) and FIFO per-actor call queues.
+
+All mutable state is owned by the event thread; the driver thread interacts
+only through ``call()`` (a closure posted to the loop) and workers through
+their sockets.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import selectors
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core import protocol
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.task_spec import (
+    ACTOR_CREATION_TASK,
+    ACTOR_TASK,
+    NORMAL_TASK,
+    TaskSpec,
+)
+
+# ---------------------------------------------------------------------------
+
+
+class SimpleFuture:
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def set(self, value=None):
+        self._value = value
+        self._event.set()
+
+    def set_error(self, err):
+        self._error = err
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _WorkerConn:
+    def __init__(self, sock, profile):
+        self.sock = sock
+        self.profile = profile
+        self.worker_id: Optional[WorkerID] = None
+        self.pid: Optional[int] = None
+        self.state = "starting"  # starting | idle | busy | actor
+        self.current_task: Optional[TaskSpec] = None
+        self.actor_id: Optional[ActorID] = None
+        self.send_lock = threading.Lock()
+
+    def send(self, msg):
+        protocol.send_msg(self.sock, msg, self.send_lock)
+
+
+class _ObjectState:
+    __slots__ = ("status", "value", "error", "size")
+
+    def __init__(self):
+        self.status = "pending"  # pending | inline | store | error
+        self.value: Optional[bytes] = None
+        self.error: Optional[Exception] = None
+        self.size = 0
+
+
+class _ActorState:
+    def __init__(self, spec: TaskSpec, name: Optional[str]):
+        self.actor_id = spec.actor_id
+        self.creation_spec = spec
+        self.name = name
+        self.state = "pending"  # pending | alive | restarting | dead
+        self.conn: Optional[_WorkerConn] = None
+        self.queue: deque = deque()  # pending method TaskSpecs (FIFO order)
+        self.running: Optional[TaskSpec] = None
+        self.restarts_left = spec.max_restarts
+        self.death_reason = ""
+
+
+class _PlacementGroup:
+    def __init__(self, pg_id, bundles: List[Dict[str, float]], strategy: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.available = [dict(b) for b in bundles]
+        self.strategy = strategy
+        self.state = "created"
+        self.ready_future: Optional[SimpleFuture] = None
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+def _acquire(avail: Dict[str, float], need: Dict[str, float]):
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _release(avail: Dict[str, float], need: Dict[str, float]):
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+# ---------------------------------------------------------------------------
+
+
+class Raylet:
+    def __init__(
+        self,
+        session_dir: str,
+        resources: Dict[str, float],
+        store_path: Optional[str],
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.session_dir = session_dir
+        self.socket_path = os.path.join(session_dir, "raylet.sock")
+        self.store_path = store_path
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.worker_env = worker_env or {}
+        self.node_id = WorkerID.from_random().hex()
+
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(128)
+        self._listener.setblocking(False)
+
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._inbox: deque = deque()
+        self._inbox_lock = threading.Lock()
+
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
+        self._sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+
+        # state (event-thread owned)
+        self._workers: Dict[socket.socket, _WorkerConn] = {}
+        self._idle: Dict[str, deque] = {}  # profile -> deque[_WorkerConn]
+        self._spawning: Dict[str, int] = {}
+        self._procs: List[subprocess.Popen] = []
+        self._unregistered: List[Tuple[subprocess.Popen, str]] = []
+        self._health_timer_armed = False
+        self._ready_queue: deque = deque()  # TaskSpecs with deps satisfied
+        self._waiting: Dict[TaskID, Tuple[TaskSpec, set]] = {}
+        self._dep_index: Dict[ObjectID, set] = {}
+        self._objects: Dict[ObjectID, _ObjectState] = {}
+        self._object_waiters: Dict[ObjectID, List[Callable]] = {}
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self._pgs: Dict[str, _PlacementGroup] = {}
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._function_table: Dict[bytes, bytes] = {}
+        self._pending_requests: Dict[Tuple[int, int], dict] = {}
+        self._timers: List[Tuple[float, int, Callable]] = []
+        self._timer_seq = itertools.count()
+        self._task_events: deque = deque(maxlen=config.task_event_buffer_size)
+        self._task_states: Dict[TaskID, dict] = {}
+        self._shutdown = False
+
+        self._thread = threading.Thread(target=self._run, name="raylet", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+    # Called from the driver thread; closures run on the event thread.
+
+    def call(self, fn: Callable, *args) -> SimpleFuture:
+        fut = SimpleFuture()
+
+        def wrapper():
+            try:
+                fut.set(fn(*args))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_error(e)
+
+        with self._inbox_lock:
+            self._inbox.append(wrapper)
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+        return fut
+
+    def call_async(self, fn: Callable, *args):
+        with self._inbox_lock:
+            self._inbox.append(lambda: fn(*args))
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    # --------------------------------------------------------------- event loop
+
+    def _run(self):
+        while not self._shutdown:
+            timeout = self._next_timer_delay()
+            events = self._sel.select(timeout)
+            now = time.monotonic()
+            while self._timers and self._timers[0][0] <= now:
+                _, _, cb = heapq.heappop(self._timers)
+                self._safe(cb)
+            for key, _ in events:
+                kind, conn = key.data
+                if kind == "accept":
+                    self._accept()
+                elif kind == "wake":
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                    self._drain_inbox()
+                elif kind == "worker":
+                    # Never let a malformed message kill the event thread; a
+                    # worker whose channel is broken is treated as dead.
+                    try:
+                        self._on_worker_readable(conn)
+                    except Exception:  # noqa: BLE001
+                        traceback.print_exc()
+                        self._safe(lambda c=conn: self._on_worker_death(c))
+        # cleanup
+        for conn in list(self._workers.values()):
+            try:
+                conn.send({"t": "shutdown"})
+                conn.sock.close()
+            except OSError:
+                pass
+        for p in self._procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _safe(self, fn):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+
+    def _drain_inbox(self):
+        while True:
+            with self._inbox_lock:
+                if not self._inbox:
+                    return
+                fn = self._inbox.popleft()
+            self._safe(fn)
+
+    def _next_timer_delay(self):
+        if not self._timers:
+            return 0.5
+        return max(0.0, self._timers[0][0] - time.monotonic())
+
+    def add_timer(self, delay: float, cb: Callable):
+        heapq.heappush(
+            self._timers, (time.monotonic() + delay, next(self._timer_seq), cb)
+        )
+
+    def _accept(self):
+        try:
+            sock, _ = self._listener.accept()
+        except OSError:
+            return
+        sock.setblocking(True)
+        conn = _WorkerConn(sock, profile="cpu")
+        self._workers[sock] = conn
+        self._sel.register(sock, selectors.EVENT_READ, ("worker", conn))
+
+    def _on_worker_readable(self, conn: _WorkerConn):
+        try:
+            msg = protocol.recv_msg(conn.sock)
+        except OSError:
+            msg = None
+        if msg is None:
+            self._on_worker_death(conn)
+            return
+        self._handle_worker_msg(conn, msg)
+
+    # --------------------------------------------------------------- workers
+
+    def _profile_key(self, spec: TaskSpec) -> str:
+        needs_tpu = spec.resources.get("TPU", 0) > 0
+        env = (spec.runtime_env or {}).get("env_vars") or {}
+        if env:
+            envkey = ",".join(f"{k}={v}" for k, v in sorted(env.items()))
+            return ("tpu|" if needs_tpu else "cpu|") + envkey
+        return "tpu" if needs_tpu else "cpu"
+
+    def _spawn_worker(self, profile: str):
+        self._spawning[profile] = self._spawning.get(profile, 0) + 1
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        # Propagate the driver's import path: workers must resolve ray_tpu
+        # (and the user's modules) no matter the cwd (reference ships the
+        # driver's sys.path through the runtime env/worker command line).
+        path_entries = [p for p in sys.path if p] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        seen = set()
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in path_entries if not (p in seen or seen.add(p))
+        )
+        if profile == "cpu" or profile.startswith("cpu|"):
+            # CPU workers must not grab the TPU chip: a single process holds
+            # the chip exclusively, so only TPU-profile workers may see it.
+            # Force (not setdefault): the environment may pin JAX_PLATFORMS
+            # to the TPU platform globally.
+            env["JAX_PLATFORMS"] = "cpu"
+        if "|" in profile:
+            for kv in profile.split("|", 1)[1].split(","):
+                k, v = kv.split("=", 1)
+                env[k] = v
+        env["RAY_TPU_WORKER_PROFILE"] = profile
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu.core.worker_main",
+            "--socket",
+            self.socket_path,
+        ]
+        if self.store_path:
+            cmd += ["--store", self.store_path]
+        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
+        self._procs.append(proc)
+        self._unregistered.append((proc, profile))
+        if not self._health_timer_armed:
+            self._health_timer_armed = True
+            self.add_timer(config.health_check_period_s, self._health_check)
+
+    def _health_check(self):
+        """Reap workers that died before registering (e.g. import failure) so
+        the scheduler doesn't wait forever on a phantom spawn (reference:
+        WorkerPool startup-token timeouts, `worker_pool.cc`)."""
+        alive = []
+        for proc, profile in self._unregistered:
+            if proc.poll() is not None:
+                self._spawning[profile] = max(0, self._spawning.get(profile, 0) - 1)
+                sys.stderr.write(
+                    f"[ray_tpu] worker (profile={profile}) exited with code "
+                    f"{proc.returncode} before registering — check worker "
+                    "environment/imports\n"
+                )
+            else:
+                alive.append((proc, profile))
+        self._unregistered = alive
+        self._schedule()
+        if self._unregistered or self._spawning:
+            self.add_timer(config.health_check_period_s, self._health_check)
+        else:
+            self._health_timer_armed = False
+
+    def _get_idle_worker(self, profile: str) -> Optional[_WorkerConn]:
+        pool = self._idle.get(profile)
+        while pool:
+            conn = pool.popleft()
+            if conn.sock in self._workers:
+                return conn
+        return None
+
+    def _return_worker(self, conn: _WorkerConn):
+        conn.state = "idle"
+        conn.current_task = None
+        self._idle.setdefault(conn.profile, deque()).append(conn)
+
+    def _on_worker_death(self, conn: _WorkerConn):
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        self._workers.pop(conn.sock, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        spec = conn.current_task
+        if conn.actor_id is not None:
+            self._on_actor_death(conn.actor_id, "worker process died")
+        elif spec is not None:
+            self._release_task_resources(spec)
+            if spec.retries_left > 0:
+                spec.retries_left -= 1
+                self._record_event(spec, "RETRYING", worker_died=True)
+                self._ready_queue.append(spec)
+            else:
+                err = WorkerCrashedError(
+                    f"worker (pid={conn.pid}) died while running {spec.name}"
+                )
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "FAILED", worker_died=True)
+        self._schedule()
+
+    # --------------------------------------------------------------- messages
+
+    def _handle_worker_msg(self, conn: _WorkerConn, msg: dict):
+        t = msg["t"]
+        if t == "register":
+            conn.worker_id = msg["worker_id"]
+            conn.pid = msg["pid"]
+            conn.profile = msg.get("profile", "cpu")
+            self._spawning[conn.profile] = max(
+                0, self._spawning.get(conn.profile, 0) - 1
+            )
+            self._unregistered = [
+                (p, prof) for p, prof in self._unregistered if p.pid != conn.pid
+            ]
+            self._return_worker(conn)
+            self._schedule()
+        elif t == "done":
+            self._on_task_done(conn, msg)
+        elif t == "submit":
+            self.submit_task(msg["spec"])
+        elif t == "request":
+            self._handle_request(conn, msg)
+
+    def _on_task_done(self, conn: _WorkerConn, msg: dict):
+        spec = conn.current_task
+        if spec is None:
+            return
+        task_failed = not msg["ok"]
+        # Actors HOLD their resources while alive (released on death); every
+        # other task releases at completion.
+        if not (spec.kind == ACTOR_CREATION_TASK and not task_failed):
+            self._release_task_resources(spec)
+        if task_failed and spec.retries_left > 0 and msg.get("retryable", True):
+            spec.retries_left -= 1
+            self._record_event(spec, "RETRYING")
+            self._ready_queue.append(spec)
+        else:
+            if task_failed:
+                err = msg["error"]
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "FAILED")
+            else:
+                inline: Dict[str, bytes] = msg.get("inline", {})
+                stored: List[str] = msg.get("stored", [])
+                for hex_id, blob in inline.items():
+                    self._object_inline(ObjectID.from_hex(hex_id), blob)
+                for hex_id in stored:
+                    self._object_in_store(ObjectID.from_hex(hex_id))
+                self._record_event(spec, "FINISHED")
+        # worker back to pool / actor next call
+        if conn.actor_id is not None:
+            actor = self._actors.get(conn.actor_id)
+            if spec.kind == ACTOR_CREATION_TASK:
+                if task_failed:
+                    # creation failed: free the worker; retry (if any) spawns
+                    # on a fresh lease, final failure kills the actor.
+                    conn.actor_id = None
+                    if actor is not None:
+                        actor.conn = None
+                    if spec.retries_left <= 0 or not msg.get("retryable", True):
+                        self._on_actor_death(spec.actor_id,
+                                             "creation task failed",
+                                             allow_restart=False)
+                    self._return_worker(conn)
+                    self._schedule()
+                    return
+                actor.state = "alive"
+                actor.conn = conn
+                conn.state = "actor"
+            if actor is not None:
+                actor.running = None
+                conn.state = "actor"
+                conn.current_task = None
+                self._pump_actor(actor)
+        else:
+            self._return_worker(conn)
+        self._schedule()
+
+    # --------------------------------------------------------------- objects
+
+    def _obj(self, oid: ObjectID) -> _ObjectState:
+        st = self._objects.get(oid)
+        if st is None:
+            st = _ObjectState()
+            self._objects[oid] = st
+        return st
+
+    def _object_inline(self, oid: ObjectID, blob: bytes):
+        st = self._obj(oid)
+        st.status = "inline"
+        st.value = blob
+        st.size = len(blob)
+        self._object_ready(oid)
+
+    def _object_in_store(self, oid: ObjectID):
+        st = self._obj(oid)
+        st.status = "store"
+        self._object_ready(oid)
+
+    def _object_error(self, oid: ObjectID, err: Exception):
+        st = self._obj(oid)
+        st.status = "error"
+        st.error = err
+        self._object_ready(oid)
+
+    def _object_ready(self, oid: ObjectID):
+        # unblock dependent tasks
+        waiting = self._dep_index.pop(oid, None)
+        if waiting:
+            for task_id in list(waiting):
+                entry = self._waiting.get(task_id)
+                if entry is None:
+                    continue
+                spec, missing = entry
+                missing.discard(oid)
+                if not missing:
+                    del self._waiting[task_id]
+                    self._enqueue_ready(spec)
+        # fire get/wait callbacks
+        for cb in self._object_waiters.pop(oid, []):
+            self._safe(lambda cb=cb: cb(oid))
+        self._schedule()
+
+    def _object_status(self, oid: ObjectID) -> str:
+        st = self._objects.get(oid)
+        return st.status if st else "pending"
+
+    # --------------------------------------------------------------- submission
+
+    def submit_task(self, spec: TaskSpec):
+        """Entry point for driver and nested worker submissions."""
+        for oid in spec.return_ids():
+            self._obj(oid)
+        if spec.kind == ACTOR_CREATION_TASK:
+            actor = _ActorState(spec, name=(spec.placement or {}).get("name"))
+            self._actors[spec.actor_id] = actor
+            if actor.name:
+                key = ((spec.placement or {}).get("namespace", ""), actor.name)
+                if key in self._named_actors:
+                    err = ValueError(f"actor name {actor.name!r} already taken")
+                    for oid in spec.return_ids():
+                        self._object_error(oid, err)
+                    return
+                self._named_actors[key] = spec.actor_id
+        missing = {
+            oid for oid in spec.dependency_ids() if self._object_status(oid) != "inline"
+            and self._object_status(oid) != "store"
+        }
+        # error deps propagate immediately
+        for oid in list(missing):
+            if self._object_status(oid) == "error":
+                err = self._objects[oid].error
+                for rid in spec.return_ids():
+                    self._object_error(rid, err)
+                self._record_event(spec, "FAILED", dep_error=True)
+                return
+        self._record_event(spec, "PENDING")
+        if missing:
+            self._waiting[spec.task_id] = (spec, missing)
+            for oid in missing:
+                self._dep_index.setdefault(oid, set()).add(spec.task_id)
+        else:
+            self._enqueue_ready(spec)
+        self._schedule()
+
+    def _enqueue_ready(self, spec: TaskSpec):
+        if spec.kind == ACTOR_TASK:
+            actor = self._actors.get(spec.actor_id)
+            if actor is None or actor.state == "dead":
+                err = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "?",
+                    actor.death_reason if actor else "unknown actor",
+                )
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                return
+            actor.queue.append(spec)
+            self._pump_actor(actor)
+        else:
+            self._ready_queue.append(spec)
+
+    # --------------------------------------------------------------- scheduling
+
+    def _task_resource_pools(self, spec: TaskSpec):
+        """Return (avail_dict, need) — node pool or placement-group bundle."""
+        placement = spec.placement or {}
+        pg_hex = placement.get("pg")
+        if pg_hex:
+            pg = self._pgs.get(pg_hex)
+            if pg is None:
+                return None, None
+            idx = placement.get("bundle", 0)
+            if idx == -1:
+                for b in pg.available:
+                    if _fits(b, spec.resources):
+                        return b, spec.resources
+                return None, spec.resources
+            return pg.available[idx], spec.resources
+        return self.resources_available, spec.resources
+
+    def _release_task_resources(self, spec: TaskSpec):
+        pool = getattr(spec, "_acquired_pool", None)
+        if pool is not None:
+            _release(pool, spec.resources)
+            spec._acquired_pool = None
+
+    def _schedule(self):
+        if not self._ready_queue:
+            return
+        deferred = deque()
+        while self._ready_queue:
+            spec = self._ready_queue.popleft()
+            pool, need = self._task_resource_pools(spec)
+            if pool is None or not _fits(pool, need):
+                deferred.append(spec)
+                continue
+            profile = self._profile_key(spec)
+            conn = self._get_idle_worker(profile)
+            if conn is None:
+                pending = self._spawning.get(profile, 0)
+                want = 1
+                if pending < want:
+                    self._spawn_worker(profile)
+                deferred.append(spec)
+                continue
+            _acquire(pool, need)
+            spec._acquired_pool = pool
+            self._dispatch(spec, conn)
+        self._ready_queue = deferred
+
+    def _dispatch(self, spec: TaskSpec, conn: _WorkerConn):
+        conn.state = "busy"
+        conn.current_task = spec
+        if spec.kind == ACTOR_CREATION_TASK:
+            conn.actor_id = spec.actor_id
+            actor = self._actors[spec.actor_id]
+            actor.conn = conn
+        arg_values: Dict[str, bytes] = {}
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            if st is not None and st.status == "inline":
+                arg_values[oid.hex()] = st.value
+        fn_blob = None
+        if spec.function_id is not None:
+            fn_blob = self._function_table.get(spec.function_id.binary())
+        self._record_event(spec, "RUNNING", pid=conn.pid)
+        conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
+                   "fn_blob": fn_blob})
+
+    def _pump_actor(self, actor: _ActorState):
+        if actor.running is not None or actor.state not in ("alive",):
+            return
+        if not actor.queue or actor.conn is None:
+            return
+        spec = actor.queue.popleft()
+        # re-check deps (they were satisfied at enqueue; error-deps handled)
+        actor.running = spec
+        conn = actor.conn
+        conn.state = "busy"
+        conn.current_task = spec
+        arg_values = {}
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            if st is not None and st.status == "inline":
+                arg_values[oid.hex()] = st.value
+        self._record_event(spec, "RUNNING", pid=conn.pid)
+        conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
+                   "fn_blob": None})
+
+    # --------------------------------------------------------------- actors
+
+    def _on_actor_death(self, actor_id: ActorID, reason: str, allow_restart=True):
+        actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        # release resources held since creation
+        self._release_task_resources(actor.creation_spec)
+        dead_conn = actor.conn
+        if dead_conn is not None:
+            dead_conn.actor_id = None
+            dead_conn.current_task = None
+            actor.conn = None
+        interrupted = actor.running
+        actor.running = None
+        if allow_restart and actor.restarts_left != 0:
+            if actor.restarts_left > 0:
+                actor.restarts_left -= 1
+            actor.state = "restarting"
+            # interrupted call fails (max_task_retries=0 semantics)
+            if interrupted is not None and interrupted.kind == ACTOR_TASK:
+                err = ActorDiedError(actor_id.hex(), reason + " (restarting)")
+                for oid in interrupted.return_ids():
+                    self._object_error(oid, err)
+            # resubmit the creation task on a fresh worker
+            creation = actor.creation_spec
+            creation._acquired_pool = None
+            self._ready_queue.append(creation)
+            actor.state = "pending"
+            self._schedule()
+            return
+        actor.state = "dead"
+        actor.death_reason = reason
+        err = ActorDiedError(actor_id.hex(), reason)
+        if interrupted is not None:
+            for oid in interrupted.return_ids():
+                self._object_error(oid, err)
+        while actor.queue:
+            spec = actor.queue.popleft()
+            for oid in spec.return_ids():
+                self._object_error(oid, err)
+        if actor.name:
+            self._named_actors = {
+                k: v for k, v in self._named_actors.items() if v != actor_id
+            }
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        if no_restart:
+            actor.restarts_left = 0
+        conn = actor.conn
+        if conn is not None and conn.pid:
+            try:
+                os.kill(conn.pid, 9)
+            except OSError:
+                pass
+        # death will be observed via socket EOF
+
+    # --------------------------------------------------------------- requests
+
+    def _handle_request(self, conn: Optional[_WorkerConn], msg: dict):
+        """Requests from workers (over socket).  Driver uses direct calls."""
+        rid = msg["rid"]
+        op = msg["op"]
+
+        def reply(ok=True, value=None, error=None):
+            conn.send({"t": "reply", "rid": rid, "ok": ok, "value": value,
+                       "error": error})
+
+        try:
+            if op == "get":
+                ids = [ObjectID.from_hex(h) for h in msg["ids"]]
+                self.async_get(ids, lambda res: conn.send(
+                    {"t": "reply", "rid": rid, "ok": True, "value": res}))
+            elif op == "wait":
+                ids = [ObjectID.from_hex(h) for h in msg["ids"]]
+                self.async_wait(
+                    ids, msg["num_returns"], msg.get("timeout"),
+                    lambda ready: conn.send(
+                        {"t": "reply", "rid": rid, "ok": True, "value": ready}),
+                )
+            elif op == "put_inline":
+                self._object_inline(ObjectID.from_hex(msg["id"]), msg["blob"])
+                reply()
+            elif op == "register_stored":
+                self._object_in_store(ObjectID.from_hex(msg["id"]))
+                reply()
+            elif op == "kv_put":
+                self._kv[(msg["ns"], msg["key"])] = msg["val"]
+                reply()
+            elif op == "kv_get":
+                reply(value=self._kv.get((msg["ns"], msg["key"])))
+            elif op == "kv_del":
+                reply(value=self._kv.pop((msg["ns"], msg["key"]), None) is not None)
+            elif op == "kv_keys":
+                prefix = msg["prefix"]
+                reply(value=[k for (ns, k) in self._kv
+                             if ns == msg["ns"] and k.startswith(prefix)])
+            elif op == "put_function":
+                self._function_table[msg["id"]] = msg["blob"]
+                reply()
+            elif op == "get_function":
+                reply(value=self._function_table.get(msg["id"]))
+            elif op == "named_actor":
+                key = (msg.get("namespace", ""), msg["name"])
+                aid = self._named_actors.get(key)
+                if aid is None:
+                    reply(ok=False, error=ValueError(
+                        f"no actor named {msg['name']!r}"))
+                else:
+                    actor = self._actors[aid]
+                    reply(value={
+                        "actor_id": aid,
+                        "creation_spec": actor.creation_spec,
+                    })
+            elif op == "actor_state":
+                actor = self._actors.get(msg["actor_id"])
+                reply(value=None if actor is None else actor.state)
+            elif op == "free":
+                for h in msg["ids"]:
+                    self._objects.pop(ObjectID.from_hex(h), None)
+                reply()
+            elif op == "cancel_request":
+                self._pending_requests.pop(msg["target_rid"], None)
+                reply()
+            else:
+                reply(ok=False, error=ValueError(f"unknown op {op}"))
+        except Exception as e:  # noqa: BLE001
+            try:
+                reply(ok=False, error=e)
+            except OSError:
+                pass
+
+    # get/wait used by both driver (via call) and workers (via requests).
+
+    def async_get(self, ids: List[ObjectID], done_cb: Callable[[dict], None]):
+        """done_cb receives {hex: ("inline", bytes) | ("store",) | ("error", e)}."""
+        remaining = set()
+        results: Dict[str, tuple] = {}
+
+        def check(oid: ObjectID):
+            st = self._objects.get(oid)
+            status = st.status if st else "pending"
+            if status == "inline":
+                results[oid.hex()] = ("inline", st.value)
+            elif status == "store":
+                results[oid.hex()] = ("store",)
+            elif status == "error":
+                results[oid.hex()] = ("error", st.error)
+            else:
+                return False
+            return True
+
+        def on_ready(oid: ObjectID):
+            if oid in remaining and check(oid):
+                remaining.discard(oid)
+                if not remaining:
+                    done_cb(results)
+
+        for oid in ids:
+            if not check(oid):
+                remaining.add(oid)
+        if not remaining:
+            done_cb(results)
+            return
+        for oid in list(remaining):
+            self._object_waiters.setdefault(oid, []).append(on_ready)
+
+    def async_wait(self, ids: List[ObjectID], num_returns: int,
+                   timeout: Optional[float], done_cb: Callable[[List[str]], None]):
+        ready: List[str] = []
+        fired = [False]
+
+        def is_ready(oid):
+            return self._object_status(oid) in ("inline", "store", "error")
+
+        def fire():
+            if not fired[0]:
+                fired[0] = True
+                done_cb(ready)
+
+        for oid in ids:
+            if is_ready(oid):
+                ready.append(oid.hex())
+        if len(ready) >= num_returns:
+            ready[:] = ready[:num_returns]
+            fire()
+            return
+
+        pending = [oid for oid in ids if not is_ready(oid)]
+
+        def on_ready(oid: ObjectID):
+            if fired[0]:
+                return
+            ready.append(oid.hex())
+            if len(ready) >= num_returns:
+                fire()
+
+        for oid in pending:
+            self._object_waiters.setdefault(oid, []).append(on_ready)
+        if timeout is not None:
+            self.add_timer(timeout, fire)
+
+    # --------------------------------------------------------------- PGs
+
+    def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
+                  strategy: str) -> bool:
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        if not _fits(self.resources_available, total):
+            # Cannot reserve now: keep pending (reference queues infeasible
+            # PGs; single-node round 1 rejects oversubscription outright if
+            # it exceeds total capacity).
+            if not _fits(self.resources_total, total):
+                return False
+        _acquire(self.resources_available, total)
+        self._pgs[pg_id] = _PlacementGroup(pg_id, bundles, strategy)
+        return True
+
+    def remove_pg(self, pg_id: str):
+        pg = self._pgs.pop(pg_id, None)
+        if pg is None:
+            return
+        total: Dict[str, float] = {}
+        for b in pg.bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        _release(self.resources_available, total)
+
+    # --------------------------------------------------------------- state
+
+    def _record_event(self, spec: TaskSpec, state: str, **extra):
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "kind": spec.kind,
+            "state": state,
+            "time": time.time(),
+            **extra,
+        }
+        self._task_events.append(ev)
+        self._task_states[spec.task_id] = ev
+
+    def state_snapshot(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "resources_total": dict(self.resources_total),
+            "resources_available": dict(self.resources_available),
+            "num_workers": len(self._workers),
+            "tasks": list(self._task_states.values()),
+            "actors": [
+                {
+                    "actor_id": a.actor_id.hex(),
+                    "state": a.state,
+                    "name": a.name,
+                    "pid": a.conn.pid if a.conn else None,
+                }
+                for a in self._actors.values()
+            ],
+            "objects": {
+                "num": len(self._objects),
+            },
+            "placement_groups": [
+                {"id": pg.pg_id, "state": pg.state, "bundles": pg.bundles}
+                for pg in self._pgs.values()
+            ],
+            "events": list(self._task_events),
+        }
+
+    # --------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+        for p in self._procs:
+            try:
+                p.terminate()
+                p.wait(timeout=2)
+            except (OSError, subprocess.TimeoutExpired):
+                try:
+                    p.kill()
+                except OSError:
+                    pass

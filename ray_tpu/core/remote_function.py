@@ -1,0 +1,88 @@
+"""``@ray_tpu.remote`` functions (reference: `python/ray/remote_function.py`)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.config import config
+from ray_tpu.core.ids import TaskID
+from ray_tpu.core.task_spec import NORMAL_TASK, TaskSpec
+from ray_tpu.core.worker import global_worker
+
+
+def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    num_tpus = opts.get("num_tpus", opts.get("num_gpus"))
+    res["CPU"] = float(1 if num_cpus is None else num_cpus)
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    return {k: v for k, v in res.items() if v}
+
+
+def _placement_from_opts(opts) -> Optional[dict]:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None:
+        return None
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return {
+            "pg": strategy.placement_group.id.hex(),
+            "bundle": strategy.placement_group_bundle_index,
+        }
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return {"node_id": strategy.node_id, "soft": strategy.soft}
+    return None
+
+
+class RemoteFunction:
+    def __init__(self, function, **options):
+        self._function = function
+        self._options = options
+        self.__name__ = getattr(function, "__name__", "remote_fn")
+        self.__doc__ = getattr(function, "__doc__", None)
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = copy.copy(self._options)
+        merged.update(new_options)
+        return RemoteFunction(self._function, **merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        worker = global_worker()
+        fid, blob = worker.register_function(self._function)
+        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        max_retries = opts.get("max_retries", config.task_retry_default)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            kind=NORMAL_TASK,
+            name=opts.get("name") or self.__name__,
+            function_blob=blob,
+            function_id=fid,
+            args=out_args,
+            kwargs=out_kwargs,
+            num_returns=opts.get("num_returns", 1),
+            resources=_build_resources(opts),
+            max_retries=max_retries,
+            retries_left=max_retries,
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=opts.get("runtime_env"),
+            placement=_placement_from_opts(opts),
+        )
+        refs = worker.submit_spec(spec)
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use '{self.__name__}.remote()'."
+        )

@@ -1,0 +1,128 @@
+"""Object serialization: pickle protocol 5 with out-of-band buffers.
+
+Mirrors the reference's serialization design
+(`python/ray/_private/serialization.py:398` — msgpack envelope + pickle5 with
+zero-copy buffer callbacks): large contiguous buffers (numpy arrays, bytes,
+jax host arrays) are split out of the pickle stream so that, when an object is
+read from the shared-memory store, numpy views can alias the mmap directly
+with no copy.
+
+Wire format (little-endian):
+
+    [u32 magic][u32 n_buffers][u64 pickled_len]
+    [u64 buf_len * n_buffers]
+    [pickled bytes]
+    [padding to 64] [buffer 0] [padding to 64] [buffer 1] ...
+
+Each buffer is aligned to 64 bytes so XLA/numpy get aligned host memory.
+
+Device arrays: ``jax.Array`` values are converted to host numpy on serialize
+(the object plane is host memory by design — device-to-device tensors move
+via collectives, not the object store; see SURVEY.md §2.6 "Object plane").
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+_MAGIC = 0x52545055  # "RTPU"
+_ALIGN = 64
+_HEADER = struct.Struct("<IIQ")
+
+
+class SerializedObject:
+    """A serialized object as (meta, list of zero-copy buffers)."""
+
+    __slots__ = ("pickled", "buffers")
+
+    def __init__(self, pickled: bytes, buffers: List[memoryview]):
+        self.pickled = pickled
+        self.buffers = buffers
+
+    def total_bytes(self) -> int:
+        size = _HEADER.size + 8 * len(self.buffers) + len(self.pickled)
+        size = _aligned(size)
+        for b in self.buffers:
+            size = _aligned(size + b.nbytes)
+        return size
+
+    def write_into(self, dest: memoryview) -> int:
+        """Serialize into a writable buffer; returns bytes written."""
+        n = len(self.buffers)
+        _HEADER.pack_into(dest, 0, _MAGIC, n, len(self.pickled))
+        off = _HEADER.size
+        for b in self.buffers:
+            struct.pack_into("<Q", dest, off, b.nbytes)
+            off += 8
+        dest[off : off + len(self.pickled)] = self.pickled
+        off = _aligned(off + len(self.pickled))
+        for b in self.buffers:
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            dest[off : off + flat.nbytes] = flat
+            off = _aligned(off + flat.nbytes)
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes())
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _device_to_host(obj: Any) -> Any:
+    # Imported lazily: the core runtime must not require jax.
+    try:
+        import jax
+        import numpy as np
+    except ImportError:
+        return obj
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    return obj
+
+
+def serialize(obj: Any) -> SerializedObject:
+    buffers: List[memoryview] = []
+
+    def callback(pb: pickle.PickleBuffer) -> bool:
+        raw = pb.raw()
+        buffers.append(raw)
+        return False  # out-of-band
+
+    obj = _device_to_host(obj)
+    pickled = pickle.dumps(obj, protocol=5, buffer_callback=callback)
+    return SerializedObject(pickled, buffers)
+
+
+def deserialize(data: memoryview) -> Any:
+    magic, n, plen = _HEADER.unpack_from(data, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt serialized object (bad magic)")
+    off = _HEADER.size
+    lens = []
+    for _ in range(n):
+        (l,) = struct.unpack_from("<Q", data, off)
+        lens.append(l)
+        off += 8
+    pickled = bytes(data[off : off + plen])
+    off = _aligned(off + plen)
+    bufs = []
+    for l in lens:
+        bufs.append(data[off : off + l])
+        off = _aligned(off + l)
+    return pickle.loads(pickled, buffers=bufs)
+
+
+def dumps(obj: Any) -> bytes:
+    return serialize(obj).to_bytes()
+
+
+def loads(data) -> Any:
+    if isinstance(data, (bytes, bytearray)):
+        data = memoryview(data)
+    return deserialize(data)

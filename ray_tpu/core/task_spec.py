@@ -1,0 +1,60 @@
+"""TaskSpec — the unit of work shipped from submitter to executor.
+
+Reference analogue: ``TaskSpecification`` (`src/ray/common/task/task_spec.h`).
+Covers normal tasks, actor-creation tasks, and actor method calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, FunctionID, ObjectID, TaskID
+
+NORMAL_TASK = "normal"
+ACTOR_CREATION_TASK = "actor_creation"
+ACTOR_TASK = "actor_task"
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    kind: str = NORMAL_TASK
+    name: str = ""
+    # Either inline pickled function/class bytes, or a FunctionID referencing
+    # the GCS function table (large callables are shipped once; reference:
+    # `python/ray/_private/function_manager.py`).
+    function_blob: Optional[bytes] = None
+    function_id: Optional[FunctionID] = None
+    # Args: list of ("v", pickled_bytes) inline values or ("ref", ObjectID).
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: List[Tuple[str, Tuple[str, Any]]] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retries_left: int = 0
+    # Retry on application exceptions too (reference: retry_exceptions=False
+    # by default — retries only cover system failures).
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    # Runtime env (env_vars, working_dir) — per-task override
+    runtime_env: Optional[dict] = None
+    # Placement: pg id hex + bundle index, or node-affinity
+    placement: Optional[dict] = None
+    # Owner bookkeeping
+    submitter: str = "driver"
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i)
+            for i in range(self.num_returns)
+        ]
+
+    def dependency_ids(self) -> List[ObjectID]:
+        deps = [a[1] for a in self.args if a[0] == "ref"]
+        deps += [v[1] for _, v in self.kwargs if v[0] == "ref"]
+        return deps

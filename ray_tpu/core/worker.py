@@ -1,0 +1,453 @@
+"""Per-process worker state — the ``CoreWorker`` equivalent.
+
+Reference analogue: `src/ray/core_worker/core_worker.h:284` +
+`python/ray/_private/worker.py`.  One ``Worker`` per process:
+
+  * DRIVER mode — owns the ``Raylet`` (in-process event thread), talks to it
+    with direct closures; owns the session (store file, worker pool).
+  * WORKER mode — subprocess connected to the raylet socket; executes tasks.
+  * LOCAL mode — ``init(local_mode=True)``: tasks execute inline in the
+    driver (reference: ``ray.init(local_mode=True)``), for debugging.
+
+Result plane: values ≤ ``config.inline_object_max_bytes`` travel inline over
+the control socket (reference inlines ≤100KB returns, `core_worker.h:988`);
+larger values go through the shm object store with zero-copy reads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import GetTimeoutError, TaskError
+from ray_tpu.core.ids import FunctionID, ObjectID, TaskID, WorkerID, put_counter
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import (
+    InProcObjectStore,
+    ShmObjectStore,
+    create_store_file,
+)
+from ray_tpu.core.raylet import Raylet
+from ray_tpu.core.task_spec import TaskSpec
+
+DRIVER = "driver"
+WORKER = "worker"
+LOCAL = "local"
+
+_global_worker: Optional["Worker"] = None
+_init_lock = threading.Lock()
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+class Worker:
+    def __init__(self, mode: str):
+        self.mode = mode
+        self.worker_id = WorkerID.from_random()
+        self.store = None
+        self.raylet: Optional[Raylet] = None
+        self.session_dir: Optional[str] = None
+        self._pushed_functions: set = set()
+        self._fn_cache: Dict[bytes, Any] = {}
+        self.actor_instance = None  # worker mode: the hosted actor
+        self.current_actor_id = None
+        self.namespace = ""
+
+    # ------------------------------------------------------------ serialization
+
+    def _serialize_value(self, value) -> serialization.SerializedObject:
+        return serialization.serialize(value)
+
+    def _prepare_args(self, args: Sequence, kwargs: Dict) -> Tuple[list, list]:
+        """Top-level ObjectRef args become dependencies; plain values are
+        serialized inline, or promoted to the store when large (reference:
+        LocalDependencyResolver inlines small args,
+        `transport/dependency_resolver.cc`)."""
+        out_args = []
+        for a in args:
+            out_args.append(self._prepare_arg(a))
+        out_kwargs = [(k, self._prepare_arg(v)) for k, v in kwargs.items()]
+        return out_args, out_kwargs
+
+    def _prepare_arg(self, value):
+        if isinstance(value, ObjectRef):
+            return ("ref", value.id())
+        blob = self._serialize_value(value).to_bytes()
+        if len(blob) > config.inline_object_max_bytes:
+            ref = self.put(value)
+            return ("ref", ref.id())
+        return ("v", blob)
+
+    def register_function(self, callable_obj) -> Tuple[FunctionID, Optional[bytes]]:
+        """Returns (function_id, inline_blob_or_None); large callables are
+        pushed to the raylet function table once (reference function_manager)."""
+        blob = cloudpickle.dumps(callable_obj)
+        fid = FunctionID(hashlib.sha1(blob).digest()[:16])
+        if len(blob) <= config.inline_object_max_bytes:
+            return fid, blob
+        if fid not in self._pushed_functions:
+            self._request("put_function", id=fid.binary(), blob=blob)
+            self._pushed_functions.add(fid)
+        return fid, None
+
+    # ------------------------------------------------------------ core ops
+
+    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        if self.mode == DRIVER:
+            self.raylet.call_async(self.raylet.submit_task, spec)
+        else:
+            self._send({"t": "submit", "spec": spec})
+        return refs
+
+    def put(self, value) -> ObjectRef:
+        oid = put_counter.next_object_id()
+        ser = self._serialize_value(value)
+        size = ser.total_bytes()
+        if size <= config.inline_object_max_bytes or self.store is None:
+            blob = ser.to_bytes()
+            if self.mode == DRIVER:
+                self.raylet.call_async(self.raylet._object_inline, oid, blob)
+            else:
+                self._request("put_inline", id=oid.hex(), blob=blob)
+        else:
+            self.store.put_serialized(oid, ser)
+            if self.mode == DRIVER:
+                self.raylet.call_async(self.raylet._object_in_store, oid)
+            else:
+                self._request("register_stored", id=oid.hex())
+        return ObjectRef(oid)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
+        ids = [r.id() for r in refs]
+        if self.mode == DRIVER:
+            from ray_tpu.core.raylet import SimpleFuture
+
+            fut = SimpleFuture()
+            self.raylet.call_async(self.raylet.async_get, ids, fut.set)
+            try:
+                results = fut.result(timeout)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out after {timeout}s"
+                ) from None
+        else:
+            results = self._request(
+                "get", ids=[i.hex() for i in ids], timeout=timeout
+            )
+        out = []
+        for oid in ids:
+            kind, *rest = results[oid.hex()]
+            if kind == "error":
+                raise rest[0]
+            if kind == "inline":
+                out.append(serialization.loads(rest[0]))
+            else:  # store
+                out.append(self.store.get(oid))
+        return out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns=1,
+             timeout: Optional[float] = None):
+        ids = [r.id() for r in refs]
+        if self.mode == DRIVER:
+            from ray_tpu.core.raylet import SimpleFuture
+
+            fut = SimpleFuture()
+            self.raylet.call_async(
+                self.raylet.async_wait, ids, num_returns, timeout, fut.set
+            )
+            ready_hex = fut.result()
+        else:
+            ready_hex = self._request(
+                "wait", ids=[i.hex() for i in ids],
+                num_returns=num_returns, timeout=timeout,
+            )
+        ready_set = set(ready_hex)
+        ready = [r for r in refs if r.hex() in ready_set]
+        not_ready = [r for r in refs if r.hex() not in ready_set]
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef]):
+        hexes = [r.hex() for r in refs]
+        if self.mode == DRIVER:
+            def _free():
+                for h in hexes:
+                    self.raylet._objects.pop(ObjectID.from_hex(h), None)
+            self.raylet.call_async(_free)
+        else:
+            self._request("free", ids=hexes)
+        if self.store is not None:
+            for r in refs:
+                try:
+                    self.store.delete(r.id())
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # KV (GCS KV equivalent — backs runtime envs, Train/Tune metadata, Serve)
+    def kv_put(self, key: bytes, value: bytes, namespace: str = ""):
+        if self.mode == DRIVER:
+            def _put():
+                self.raylet._kv[(namespace, key)] = value
+            self.raylet.call(_put).result()
+        else:
+            self._request("kv_put", ns=namespace, key=key, val=value)
+
+    def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        if self.mode == DRIVER:
+            return self.raylet.call(
+                lambda: self.raylet._kv.get((namespace, key))
+            ).result()
+        return self._request("kv_get", ns=namespace, key=key)
+
+    def kv_del(self, key: bytes, namespace: str = ""):
+        if self.mode == DRIVER:
+            return self.raylet.call(
+                lambda: self.raylet._kv.pop((namespace, key), None) is not None
+            ).result()
+        return self._request("kv_del", ns=namespace, key=key)
+
+    def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
+        if self.mode == DRIVER:
+            return self.raylet.call(
+                lambda: [k for (ns, k) in self.raylet._kv
+                         if ns == namespace and k.startswith(prefix)]
+            ).result()
+        return self._request("kv_keys", ns=namespace, prefix=prefix)
+
+    # ------------------------------------------------------------ worker mode
+
+    def _send(self, msg):
+        raise NotImplementedError
+
+    def _request(self, op, **fields):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Driver bring-up / teardown
+
+
+def _gc_stale_stores(shm_dir: str):
+    """Remove store files whose owning driver (pid in the name) is gone —
+    crash-safety for the file-backed shm arena."""
+    try:
+        for name in os.listdir(shm_dir):
+            if not name.startswith("rt_store_"):
+                continue
+            parts = name.split("_")
+            try:
+                pid = int(parts[2])
+            except (IndexError, ValueError):
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join(shm_dir, name))
+                except OSError:
+                    pass
+            except PermissionError:
+                pass
+    except OSError:
+        pass
+
+
+class DriverWorker(Worker):
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 object_store_memory=None, namespace: str = ""):
+        super().__init__(DRIVER)
+        self.namespace = namespace or ""
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        self.session_dir = os.path.join(
+            config.temp_dir, f"session_{ts}_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+
+        total = {"CPU": float(num_cpus if num_cpus is not None else os.cpu_count())}
+        if num_tpus is None:
+            num_tpus = int(os.environ.get("RAY_TPU_NUM_CHIPS", "0"))
+            if num_tpus == 0 and "jax" in __import__("sys").modules:
+                try:
+                    import jax
+
+                    num_tpus = sum(
+                        1 for d in jax.devices() if d.platform != "cpu"
+                    )
+                except Exception:  # noqa: BLE001
+                    num_tpus = 0
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update(resources or {})
+
+        store_mb = (object_store_memory or config.object_store_memory_mb * (1 << 20)) // (1 << 20)
+        store_path = None
+        if not config.object_store_fallback_inproc:
+            shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else self.session_dir
+            _gc_stale_stores(shm_dir)
+            store_path = os.path.join(
+                shm_dir, f"rt_store_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+            )
+            create_store_file(store_path, int(store_mb) << 20)
+            self.store = ShmObjectStore(store_path)
+        else:
+            self.store = InProcObjectStore()
+
+        self.store_path = store_path
+        self.raylet = Raylet(
+            self.session_dir, total, store_path,
+            worker_env={"RAY_TPU_SESSION_DIR": self.session_dir},
+        )
+        if config.prestart_workers:
+            n = min(int(total["CPU"]), 4)
+            for _ in range(n):
+                self.raylet.call_async(self.raylet._spawn_worker, "cpu")
+        # Clean up the shm store even if the user forgets shutdown() or the
+        # driver exits on an exception.
+        import atexit
+
+        atexit.register(self._atexit_cleanup)
+
+    def _atexit_cleanup(self):
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self):
+        self.raylet.shutdown()
+        try:
+            self.store.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self.store_path and os.path.exists(self.store_path):
+            try:
+                os.unlink(self.store_path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Local mode: inline execution (ray.init(local_mode=True) equivalent)
+
+
+class LocalWorker(Worker):
+    def __init__(self):
+        super().__init__(LOCAL)
+        self._objects: Dict[ObjectID, Tuple[str, Any]] = {}
+        self._actors: Dict[Any, Any] = {}
+        self.store = InProcObjectStore()
+
+    def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        from ray_tpu.core.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK
+
+        fn = (cloudpickle.loads(spec.function_blob)
+              if spec.function_blob is not None else None)
+        args, kwargs = self._resolve_args(spec)
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        try:
+            if spec.kind == ACTOR_CREATION_TASK:
+                inst = fn(*args, **kwargs)
+                self._actors[spec.actor_id] = inst
+                result = None
+            elif spec.kind == ACTOR_TASK:
+                inst = self._actors[spec.actor_id]
+                result = getattr(inst, spec.method_name)(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
+            if spec.num_returns == 1:
+                self._objects[refs[0].id()] = ("v", result)
+            else:
+                for r, v in zip(refs, result):
+                    self._objects[r.id()] = ("v", v)
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            err = TaskError(spec.name, traceback.format_exc(), e)
+            for r in refs:
+                self._objects[r.id()] = ("e", err)
+        return refs
+
+    def _resolve_args(self, spec):
+        def resolve(entry):
+            kind, payload = entry
+            if kind == "ref":
+                tag, v = self._objects[payload]
+                if tag == "e":
+                    raise v
+                return v
+            return serialization.loads(payload)
+
+        args = [resolve(a) for a in spec.args]
+        kwargs = {k: resolve(v) for k, v in spec.kwargs}
+        return args, kwargs
+
+    def put(self, value) -> ObjectRef:
+        oid = put_counter.next_object_id()
+        self._objects[oid] = ("v", value)
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout=None):
+        out = []
+        for r in refs:
+            tag, v = self._objects[r.id()]
+            if tag == "e":
+                raise v
+            out.append(v)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        return list(refs[:num_returns]), list(refs[num_returns:])
+
+    def free(self, refs):
+        for r in refs:
+            self._objects.pop(r.id(), None)
+
+    def kv_put(self, key, value, namespace=""):
+        self._objects[("kv", namespace, key)] = ("v", value)
+
+    def kv_get(self, key, namespace=""):
+        entry = self._objects.get(("kv", namespace, key))
+        return entry[1] if entry else None
+
+    def kv_del(self, key, namespace=""):
+        return self._objects.pop(("kv", namespace, key), None) is not None
+
+    def kv_keys(self, prefix, namespace=""):
+        return [k[2] for k in self._objects
+                if isinstance(k, tuple) and k[0] == "kv" and k[1] == namespace
+                and k[2].startswith(prefix)]
+
+    def shutdown(self):
+        self._objects.clear()
+        self._actors.clear()
+
+
+# ---------------------------------------------------------------------------
+
+
+def init_worker(worker: Worker):
+    global _global_worker
+    with _init_lock:
+        _global_worker = worker
+
+
+def clear_worker():
+    global _global_worker
+    with _init_lock:
+        _global_worker = None

@@ -1,0 +1,211 @@
+"""Worker subprocess entrypoint — the ``default_worker.py`` equivalent.
+
+Reference analogue: `python/ray/_private/workers/default_worker.py` +
+``CoreWorker.run_task_loop`` (`python/ray/_raylet.pyx:2702`).
+
+Threading model: a reader thread drains the raylet socket (demuxing task
+dispatches from request replies) so that a task blocked in ``get()`` can
+still receive its reply; the main thread is the single task executor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import socket
+import sys
+import threading
+import traceback
+from typing import Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core import protocol, serialization
+from ray_tpu.core.config import config
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.task_spec import (
+    ACTOR_CREATION_TASK,
+    ACTOR_TASK,
+    TaskSpec,
+)
+from ray_tpu.core.worker import WORKER, Worker, init_worker
+
+
+class RemoteWorker(Worker):
+    """Worker-process side of the control socket."""
+
+    def __init__(self, sock: socket.socket):
+        super().__init__(WORKER)
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+        self._pending: Dict[int, dict] = {}
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while True:
+            try:
+                msg = protocol.recv_msg(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                os._exit(0)  # raylet gone — die quietly
+            t = msg.get("t")
+            if t == "task":
+                self.task_queue.put(msg)
+            elif t == "reply":
+                entry = self._pending.pop(msg["rid"], None)
+                if entry is not None:
+                    entry["msg"] = msg
+                    entry["event"].set()
+            elif t == "shutdown":
+                os._exit(0)
+
+    def _send(self, msg):
+        protocol.send_msg(self.sock, msg, self.send_lock)
+
+    def _request(self, op, **fields):
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        entry = {"event": threading.Event(), "msg": None}
+        self._pending[rid] = entry
+        self._send({"t": "request", "rid": rid, "op": op, **fields})
+        entry["event"].wait()
+        msg = entry["msg"]
+        if not msg["ok"]:
+            raise msg["error"]
+        return msg["value"]
+
+
+def _resolve_callable(worker: RemoteWorker, spec: TaskSpec, fn_blob):
+    key = spec.function_id.binary() if spec.function_id else None
+    if key is not None and key in worker._fn_cache:
+        return worker._fn_cache[key]
+    blob = fn_blob or spec.function_blob
+    if blob is None and spec.function_id is not None:
+        blob = worker._request("get_function", id=spec.function_id.binary())
+    if blob is None:
+        raise RuntimeError(f"no function payload for task {spec.name}")
+    fn = cloudpickle.loads(blob)
+    if key is not None:
+        worker._fn_cache[key] = fn
+    return fn
+
+
+def _resolve_args(worker: RemoteWorker, spec: TaskSpec, arg_values):
+    def resolve(entry):
+        kind, payload = entry
+        if kind == "v":
+            return serialization.loads(payload)
+        oid: ObjectID = payload
+        blob = arg_values.get(oid.hex())
+        if blob is not None:
+            return serialization.loads(blob)
+        if worker.store is None:
+            raise RuntimeError("no object store attached")
+        return worker.store.get(oid, timeout=60.0)
+
+    args = [resolve(a) for a in spec.args]
+    kwargs = {k: resolve(v) for k, v in spec.kwargs}
+    return args, kwargs
+
+
+def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
+    inline: Dict[str, bytes] = {}
+    stored = []
+    if spec.num_returns == 1:
+        values = [result]
+    else:
+        values = list(result)
+        if len(values) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={spec.num_returns} "
+                f"but returned {len(values)} values"
+            )
+    for oid, val in zip(spec.return_ids(), values):
+        ser = serialization.serialize(val)
+        if ser.total_bytes() <= config.inline_object_max_bytes or worker.store is None:
+            inline[oid.hex()] = ser.to_bytes()
+        else:
+            worker.store.put_serialized(oid, ser)
+            stored.append(oid.hex())
+    return inline, stored
+
+
+def _apply_runtime_env(spec: TaskSpec):
+    env = spec.runtime_env or {}
+    wd = env.get("working_dir")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+
+
+def execute_task(worker: RemoteWorker, msg: dict):
+    spec: TaskSpec = msg["spec"]
+    try:
+        _apply_runtime_env(spec)
+        args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
+        if spec.kind == ACTOR_CREATION_TASK:
+            cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
+            worker.actor_instance = cls(*args, **kwargs)
+            worker.current_actor_id = spec.actor_id
+            result = None
+        elif spec.kind == ACTOR_TASK:
+            if spec.method_name == "__ray_terminate__":
+                worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
+                              "inline": {spec.return_ids()[0].hex():
+                                         serialization.dumps(None)},
+                              "stored": []})
+                os._exit(0)
+            inst = worker.actor_instance
+            if inst is None:
+                raise RuntimeError("actor instance missing")
+            result = getattr(inst, spec.method_name)(*args, **kwargs)
+        else:
+            fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
+            result = fn(*args, **kwargs)
+        inline, stored = _package_results(worker, spec, result)
+        worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
+                      "inline": inline, "stored": stored})
+    except Exception as e:  # noqa: BLE001
+        tb = traceback.format_exc()
+        err = TaskError(spec.name, tb, None)
+        worker._send({
+            "t": "done", "task_id": spec.task_id, "ok": False,
+            "error": err, "retryable": spec.retry_exceptions,
+        })
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--store", default=None)
+    args = parser.parse_args()
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.socket)
+    worker = RemoteWorker(sock)
+    if args.store:
+        worker.store = ShmObjectStore(args.store)
+    init_worker(worker)
+    worker._send({
+        "t": "register",
+        "pid": os.getpid(),
+        "worker_id": worker.worker_id,
+        "profile": os.environ.get("RAY_TPU_WORKER_PROFILE", "cpu"),
+    })
+    while True:
+        msg = worker.task_queue.get()
+        execute_task(worker, msg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Lazy build of the native components.
+
+The shared library is compiled on first import (and cached next to the
+sources).  We deliberately avoid setuptools here: the native runtime has no
+Python-API dependency (pure ``extern "C"`` + ctypes), so a single g++
+invocation suffices and works in hermetic environments.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "object_store.cc")
+_LIB = os.path.join(_DIR, "librt_store.so")
+_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def lib_path() -> str:
+    """Return path to librt_store.so, building it if stale or missing."""
+    with _lock:
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            tmp = _LIB + ".tmp"
+            cmd = [
+                "g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
+                "-o", tmp, _SRC,
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+                )
+            os.replace(tmp, _LIB)
+    return _LIB

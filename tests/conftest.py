@@ -1,0 +1,51 @@
+"""Test fixtures.
+
+Multi-chip sharding tests run on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``), the single-machine analogue of the
+reference's fake multi-node cluster (`python/ray/cluster_utils.py:99`).
+These env vars must be set before jax is first imported, hence conftest.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env may pin the TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep worker subprocesses on CPU too (workers inherit the driver env).
+os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_shared():
+    """Shared runtime for a whole test module (cheaper than per-test)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_local_mode():
+    import ray_tpu
+
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,231 @@
+"""Actor tests (modelled on `python/ray/tests/test_actor*.py` coverage)."""
+
+import time
+
+import pytest
+
+
+def test_actor_basic(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray.get(c.incr.remote()) == 11
+    assert ray.get(c.incr.remote(by=5)) == 16
+    assert ray.get(c.value.remote()) == 16
+
+
+def test_actor_state_isolated(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Holder:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+    a = Holder.remote()
+    b = Holder.remote()
+    assert ray.get(a.add.remote(1)) == 1
+    assert ray.get(b.add.remote(1)) == 1
+    assert ray.get(a.add.remote(2)) == 2
+
+
+def test_actor_ordering(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def push(self, x):
+            self.log.append(x)
+
+        def get_log(self):
+            return self.log
+
+    s = Seq.remote()
+    for i in range(20):
+        s.push.remote(i)
+    assert ray.get(s.get_log.remote()) == list(range(20))
+
+
+def test_actor_method_error(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    a = Bad.remote()
+    with pytest.raises(ray.TaskError):
+        ray.get(a.boom.remote())
+    # actor survives an application error
+    assert ray.get(a.ok.remote()) == "fine"
+
+
+def test_named_actor(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="the_registry").remote()
+    h = ray.get_actor("the_registry")
+    assert ray.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        ray.get_actor("nonexistent_actor")
+
+
+def test_actor_handle_passing(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray.remote
+    def writer(store, value):
+        import ray_tpu
+
+        ray_tpu.get(store.set.remote(value))
+        return True
+
+    s = Store.remote()
+    assert ray.get(writer.remote(s, 42))
+    assert ray.get(s.get.remote()) == 42
+
+
+def test_kill_actor(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Victim:
+        def ping(self):
+            return "alive"
+
+    v = Victim.remote()
+    assert ray.get(v.ping.remote()) == "alive"
+    ray.kill(v)
+    with pytest.raises(ray.ActorDiedError):
+        ray.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_shared):
+    ray = ray_shared
+
+    @ray.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def dies(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    pid1 = ray.get(p.pid.remote())
+    p.dies.remote()
+    # wait for restart; first calls may race the death
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray.get(p.pid.remote(), timeout=10)
+            break
+        except (ray.ActorDiedError, ray.GetTimeoutError):
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_worker_crash_retry(ray_shared):
+    ray = ray_shared
+
+    # A task that kills its worker the first time but succeeds on retry,
+    # coordinated through the KV store.
+    @ray.remote
+    class Flag:
+        def __init__(self):
+            self.seen = 0
+
+        def mark(self):
+            self.seen += 1
+            return self.seen
+
+    flag = Flag.remote()
+
+    @ray.remote(max_retries=2)
+    def flaky(f):
+        import os
+
+        import ray_tpu
+
+        n = ray_tpu.get(f.mark.remote())
+        if n == 1:
+            os._exit(1)
+        return "recovered"
+
+    assert ray.get(flaky.remote(flag), timeout=60) == "recovered"
+
+
+def test_task_no_retry_on_app_error(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    class Count:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Count.remote()
+
+    @ray.remote(max_retries=3)
+    def failing(counter):
+        import ray_tpu
+
+        ray_tpu.get(counter.bump.remote())
+        raise ValueError("app error")
+
+    with pytest.raises(ray.TaskError):
+        ray.get(failing.remote(c))
+    assert ray.get(c.value.remote()) == 1  # ran exactly once

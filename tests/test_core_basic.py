@@ -1,0 +1,169 @@
+"""Core API tests: tasks, objects, errors, parallelism.
+
+Modelled on the reference's `python/ray/tests/test_basic.py` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(ray_shared):
+    ray = ray_shared
+    ref = ray.put({"a": 1, "b": [1, 2, 3]})
+    assert ray.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_array(ray_shared):
+    ray = ray_shared
+    arr = np.random.rand(1 << 20).astype(np.float32)  # 4MB -> shm store
+    ref = ray.put(arr)
+    out = ray.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_simple_task(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray.get(r2) == 40
+
+
+def test_task_large_result(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def big():
+        return np.ones((1024, 1024), dtype=np.float32)
+
+    out = ray.get(big.remote())
+    assert out.shape == (1024, 1024)
+    assert out.sum() == 1024 * 1024
+
+
+def test_task_kwargs_and_closure(ray_shared):
+    ray = ray_shared
+    factor = 7
+
+    @ray.remote
+    def f(x, y=1):
+        return factor * x + y
+
+    assert ray.get(f.remote(2, y=3)) == 17
+
+
+def test_num_returns(ray_shared):
+    ray = ray_shared
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ray.TaskError) as ei:
+        ray.get(boom.remote())
+    assert "bad" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray.TaskError):
+        ray.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    refs = [sleepy.remote(0.01), sleepy.remote(5.0)]
+    ready, not_ready = ray.wait(refs, num_returns=1, timeout=3.0)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray.get(ready[0]) == 0.01
+
+
+def test_parallelism(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def sleep_pid():
+        time.sleep(0.4)
+        import os
+
+        return os.getpid()
+
+    start = time.monotonic()
+    pids = ray.get([sleep_pid.remote() for _ in range(4)])
+    elapsed = time.monotonic() - start
+    assert len(set(pids)) >= 2, "tasks should run in separate processes"
+    assert elapsed < 1.5, f"4x0.4s tasks should run in parallel, took {elapsed}"
+
+
+def test_nested_tasks(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def inner(x):
+        return x * 10
+
+    @ray.remote
+    def outer(x):
+        import ray_tpu
+
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray.get(outer.remote(4)) == 41
+
+
+def test_get_timeout(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray.GetTimeoutError):
+        ray.get(forever.remote(), timeout=0.2)
+
+
+def test_cluster_resources(ray_shared):
+    ray = ray_shared
+    assert ray.cluster_resources()["CPU"] == 8.0

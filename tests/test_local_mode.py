@@ -1,0 +1,27 @@
+"""local_mode (inline execution) tests — separate module because the
+runtime singleton is per-process."""
+
+
+def test_local_mode(ray_local_mode):
+    ray = ray_local_mode
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get(f.remote(1)) == 2
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray.get(c.incr.remote()) == 1
+    assert ray.get(c.incr.remote()) == 2
+
+
