@@ -1,0 +1,71 @@
+"""Headline benchmark: GPT-2 124M train-step throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Baseline: the reference stack's per-chip A100 throughput for GPT-2 124M
+pretraining (torch + flash-attention ≈ 178k tokens/s on A100-40GB; the
+BASELINE.json north star is >90% of that per chip).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+A100_TOKENS_PER_SEC = 178_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt2
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    if on_tpu:
+        batch, seq, steps = 8, 1024, 10
+        cfg = gpt2.GPT2_SMALL
+    else:  # smoke-test path for CPU-only environments
+        batch, seq, steps = 2, 128, 2
+        cfg = gpt2.GPT2_TINY
+
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                cfg.vocab_size)
+    batch_d = {"tokens": tokens}
+
+    # warmup / compile.  NOTE: sync via host transfer (float()), not
+    # block_until_ready — the axon-tunnel backend returns from
+    # block_until_ready before execution completes.
+    params, opt_state, metrics = step(params, opt_state, batch_d)
+    float(metrics["loss"])
+
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, metrics = step(params, opt_state, batch_d)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        best = max(best, batch * seq * steps / dt)
+    tokens_per_sec = best
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
+                  else "gpt2_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / A100_TOKENS_PER_SEC, 4)
+                       if on_tpu else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+"""Collective communication library — `ray.util.collective` API shape.
+
+Reference analogue: `python/ray/util/collective/collective.py:40-258` (NCCL
+via cupy / Gloo via pygloo groups keyed by name, created over actors).
+TPU-native redesign (SURVEY.md §2.6):
+
+  * backend "xla"  — collectives INSIDE jit programs: thin named-axis
+    wrappers over `lax.psum` / `all_gather` / `ppermute` / etc.  This is the
+    ICI path: XLA schedules and overlaps them; there is no separate
+    communicator object, the mesh axis IS the group.
+  * backend "host" — cross-process collectives OUTSIDE jit, built on the
+    driver's KV store + barrier generation counting.  This is the
+    control/DCN path the worker group uses for small host-side sync
+    (rendezvous, metric reduction), the role Gloo plays in the reference.
+
+``init_collective_group`` / ``allreduce`` / ... mirror the reference's
+module-level functions so user code ports 1:1.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# In-jit (XLA/ICI) collectives — the tensor plane.
+
+
+class xla:
+    """Named-axis collectives to use inside jit/shard_map programs."""
+
+    @staticmethod
+    def allreduce(x, axis_name: str, op: str = "sum"):
+        from jax import lax
+
+        if op == "sum":
+            return lax.psum(x, axis_name)
+        if op == "max":
+            return lax.pmax(x, axis_name)
+        if op == "min":
+            return lax.pmin(x, axis_name)
+        if op == "mean":
+            return lax.pmean(x, axis_name)
+        raise ValueError(f"unknown op {op}")
+
+    @staticmethod
+    def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+        from jax import lax
+
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def reducescatter(x, axis_name: str, axis: int = 0, op: str = "sum"):
+        from jax import lax
+
+        if op != "sum":
+            raise ValueError("reducescatter supports sum")
+        return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+    @staticmethod
+    def broadcast(x, axis_name: str, root: int = 0):
+        from jax import lax
+        import jax.numpy as jnp
+
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
+
+    @staticmethod
+    def permute(x, axis_name: str, perm: List[tuple]):
+        from jax import lax
+
+        return lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def alltoall(x, axis_name: str, split_axis: int = 0,
+                 concat_axis: int = 0):
+        from jax import lax
+
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+# --------------------------------------------------------------------------
+# Host-level (cross-process) collectives over the driver KV store.
+
+
+class _HostGroup:
+    def __init__(self, name: str, world_size: int, rank: int):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq = 0
+
+    # -- kv helpers ---------------------------------------------------------
+
+    def _kv(self):
+        from ray_tpu.core.worker import global_worker
+
+        return global_worker()
+
+    def _put(self, key: str, value: Any):
+        self._kv().kv_put(key.encode(), pickle.dumps(value),
+                          namespace="collective")
+
+    def _get(self, key: str, timeout: float = 120.0):
+        w = self._kv()
+        deadline = time.monotonic() + timeout
+        while True:
+            blob = w.kv_get(key.encode(), namespace="collective")
+            if blob is not None:
+                return pickle.loads(blob)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective key {key} not posted")
+            time.sleep(0.002)
+
+    # -- ops ----------------------------------------------------------------
+
+    def barrier(self, timeout: float = 120.0):
+        self._seq += 1
+        self._put(f"{self.name}/bar{self._seq}/{self.rank}", True)
+        for r in range(self.world_size):
+            self._get(f"{self.name}/bar{self._seq}/{r}", timeout)
+
+    def allgather_obj(self, obj: Any, timeout: float = 120.0) -> List[Any]:
+        self._seq += 1
+        self._put(f"{self.name}/ag{self._seq}/{self.rank}", obj)
+        return [self._get(f"{self.name}/ag{self._seq}/{r}", timeout)
+                for r in range(self.world_size)]
+
+    def allreduce(self, arr, op: str = "sum", timeout: float = 120.0):
+        parts = self.allgather_obj(np.asarray(arr), timeout)
+        stack = np.stack(parts)
+        if op == "sum":
+            return stack.sum(0)
+        if op == "mean":
+            return stack.mean(0)
+        if op == "max":
+            return stack.max(0)
+        if op == "min":
+            return stack.min(0)
+        raise ValueError(f"unknown op {op}")
+
+    def broadcast(self, arr, root: int = 0, timeout: float = 120.0):
+        self._seq += 1
+        if self.rank == root:
+            self._put(f"{self.name}/bc{self._seq}", np.asarray(arr))
+            return np.asarray(arr)
+        return self._get(f"{self.name}/bc{self._seq}", timeout)
+
+    def send_obj(self, obj: Any, dst: int):
+        self._seq += 1
+        self._put(f"{self.name}/p2p{self._seq}/{self.rank}->{dst}", obj)
+
+    def recv_obj(self, src: int, timeout: float = 120.0):
+        self._seq += 1
+        return self._get(f"{self.name}/p2p{self._seq}/{src}->{self.rank}",
+                         timeout)
+
+
+_groups: Dict[str, _HostGroup] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> _HostGroup:
+    """Create/join a named collective group (reference:
+    `collective.py:120` `init_collective_group`)."""
+    if backend not in ("host", "xla"):
+        raise ValueError("backend must be 'host' or 'xla'")
+    g = _HostGroup(group_name, world_size, rank)
+    _groups[group_name] = g
+    return g
+
+
+def get_group(group_name: str = "default") -> _HostGroup:
+    if group_name not in _groups:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return _groups[group_name]
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _groups.pop(group_name, None)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(tensor, op)
+
+
+def allgather(obj, group_name: str = "default"):
+    return get_group(group_name).allgather_obj(obj)
+
+
+def broadcast(tensor, root: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(tensor, root)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def send(obj, dst_rank: int, group_name: str = "default"):
+    get_group(group_name).send_obj(obj, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return get_group(group_name).recv_obj(src_rank)

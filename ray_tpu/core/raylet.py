@@ -352,6 +352,10 @@ class Raylet:
             # Force (not setdefault): the environment may pin JAX_PLATFORMS
             # to the TPU platform globally.
             env["JAX_PLATFORMS"] = "cpu"
+            # The TPU-tunnel sitecustomize force-registers its PJRT platform
+            # programmatically (overriding JAX_PLATFORMS); dropping its
+            # trigger var keeps CPU workers off the chip entirely.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         if "|" in profile:
             for kv in profile.split("|", 1)[1].split(","):
                 k, v = kv.split("=", 1)

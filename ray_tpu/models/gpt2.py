@@ -1,0 +1,186 @@
+"""GPT-2 — pure-JAX transformer, TPU-first.
+
+The flagship training model (BASELINE.json: "GPT-2 124M/1.5B data-parallel
+pretraining").  Design choices for the MXU/HBM:
+
+  * params stay f32 (optimizer quality), activations/matmuls run bf16
+    (`compute_dtype`) — MXU native.
+  * attention goes through the Pallas flash kernel
+    (`ray_tpu/ops/flash_attention.py`); sequence-parallel configs swap in
+    ring attention (`ray_tpu/parallel/ring_attention.py`) under shard_map.
+  * param names follow the logical-dim heuristics in
+    `ray_tpu/parallel/sharding.py` so `ShardingConfig` can place every leaf
+    (wte → (vocab, embed), c_attn → (embed, heads), mlp c_proj →
+    (mlp, embed), ...).
+  * static shapes everywhere; the whole train step jits to one XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    block_size: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    compute_dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | ring | ulysses | dense
+    remat: bool = False      # jax.checkpoint each block (trade FLOPs for HBM)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+GPT2_SMALL = GPT2Config()
+GPT2_MEDIUM = GPT2Config(n_layer=24, n_head=16, n_embd=1024)
+GPT2_LARGE = GPT2Config(n_layer=36, n_head=20, n_embd=1280)
+GPT2_XL = GPT2Config(n_layer=48, n_head=25, n_embd=1600)
+GPT2_TINY = GPT2Config(vocab_size=512, block_size=128, n_layer=2, n_head=2,
+                       n_embd=64)
+
+
+def init_params(rng, cfg: GPT2Config) -> Dict[str, Any]:
+    std = 0.02
+    proj_std = std / math.sqrt(2 * cfg.n_layer)
+    keys = jax.random.split(rng, 4 + cfg.n_layer)
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s)
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": normal(keys[0], (cfg.vocab_size, cfg.n_embd))},
+        "wpe": {"embedding": normal(keys[1], (cfg.block_size, cfg.n_embd), 0.01)},
+        "ln_f": {"scale": jnp.ones((cfg.n_embd,)), "bias": jnp.zeros((cfg.n_embd,))},
+    }
+    for i in range(cfg.n_layer):
+        k1, k2, k3, k4 = jax.random.split(keys[4 + i], 4)
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": jnp.ones((cfg.n_embd,)),
+                     "bias": jnp.zeros((cfg.n_embd,))},
+            "attn": {
+                "c_attn": {"kernel": normal(k1, (cfg.n_embd, 3 * cfg.n_embd)),
+                           "bias": jnp.zeros((3 * cfg.n_embd,))},
+                "c_proj": {"kernel": normal(k2, (cfg.n_embd, cfg.n_embd),
+                                            proj_std),
+                           "bias": jnp.zeros((cfg.n_embd,))},
+            },
+            "ln_2": {"scale": jnp.ones((cfg.n_embd,)),
+                     "bias": jnp.zeros((cfg.n_embd,))},
+            "mlp": {
+                "c_fc": {"kernel": normal(k3, (cfg.n_embd, 4 * cfg.n_embd)),
+                         "bias": jnp.zeros((4 * cfg.n_embd,))},
+                "c_proj": {"kernel": normal(k4, (4 * cfg.n_embd, cfg.n_embd),
+                                            proj_std),
+                           "bias": jnp.zeros((cfg.n_embd,))},
+            },
+        }
+    return params
+
+
+def _layer_norm(x, p, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    return y * p["scale"] + p["bias"]
+
+
+def _attention(x, p, cfg: GPT2Config, mesh=None):
+    B, S, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = x @ p["c_attn"]["kernel"].astype(x.dtype) + p["c_attn"]["bias"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    if cfg.attention in ("ring", "ulysses"):
+        # sequence parallelism: shard_map over the bound mesh's sp axis
+        from ray_tpu.parallel.context import require_mesh
+        from ray_tpu.parallel.ring_attention import ring_attention_sharded
+
+        o = ring_attention_sharded(q, k, v, require_mesh(), causal=True,
+                                   variant=cfg.attention)
+    elif cfg.attention == "dense":
+        from ray_tpu.ops.flash_attention import _reference_attention
+
+        o, _ = _reference_attention(q, k, v, D ** -0.5, True)
+        o = o.astype(x.dtype)
+    else:
+        o = flash_attention(q, k, v, True)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+    return o @ p["c_proj"]["kernel"].astype(x.dtype) + p["c_proj"]["bias"].astype(x.dtype)
+
+
+def _mlp(x, p):
+    h = x @ p["c_fc"]["kernel"].astype(x.dtype) + p["c_fc"]["bias"].astype(x.dtype)
+    h = jax.nn.gelu(h)
+    return h @ p["c_proj"]["kernel"].astype(x.dtype) + p["c_proj"]["bias"].astype(x.dtype)
+
+
+def _block(x, p, cfg: GPT2Config):
+    x = x + _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg)
+    x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+    return x
+
+
+def forward(params, tokens, cfg: GPT2Config):
+    """tokens (B, S) int32 -> logits (B, S, vocab)."""
+    B, S = tokens.shape
+    x = (params["wte"]["embedding"][tokens]
+         + params["wpe"]["embedding"][:S][None])
+    x = x.astype(cfg.compute_dtype)
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2,))
+    for i in range(cfg.n_layer):
+        x = block(x, params[f"h_{i}"], cfg)
+    x = _layer_norm(x.astype(jnp.float32), params["ln_f"])
+    logits = x @ params["wte"]["embedding"].T
+    return logits
+
+
+def loss_fn(params, batch, cfg: GPT2Config):
+    """batch: {"tokens": (B, S+1)} — next-token cross entropy."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: GPT2Config, optimizer):
+    """Returns train_step(params, opt_state, batch) -> (params, opt_state,
+    metrics) — jit it with the appropriate shardings."""
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, {"loss": loss}
+
+    return train_step
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def count_flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
+    """~6N + attention flops per token (PaLM appendix formula)."""
+    n = (12 * cfg.n_layer * cfg.n_embd ** 2 * (1 + 1 / 3)
+         + 2 * cfg.vocab_size * cfg.n_embd)
+    return 6 * n + 12 * cfg.n_layer * cfg.n_embd * seq_len

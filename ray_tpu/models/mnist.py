@@ -1,0 +1,66 @@
+"""MNIST CNN — the PR-1 reference config ("TorchTrainer MNIST CNN,
+num_workers=2", BASELINE.json) rebuilt as a pure-JAX model for the Train
+layer's end-to-end tests."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(rng) -> Dict[str, Any]:
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def he(key, shape):
+        fan_in = shape[0] * shape[1] * shape[2] if len(shape) == 4 else shape[0]
+        return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+    return {
+        "conv1": {"kernel": he(k1, (3, 3, 1, 32)), "bias": jnp.zeros((32,))},
+        "conv2": {"kernel": he(k2, (3, 3, 32, 64)), "bias": jnp.zeros((64,))},
+        "fc1": {"kernel": he(k3, (7 * 7 * 64, 128)), "bias": jnp.zeros((128,))},
+        "fc2": {"kernel": he(k4, (128, 10)), "bias": jnp.zeros((10,))},
+    }
+
+
+def forward(params, x):
+    """x: (B, 28, 28, 1) -> logits (B, 10)."""
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1"]["kernel"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + params["conv1"]["bias"]
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
+                              (1, 2, 2, 1), "VALID")
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2"]["kernel"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + params["conv2"]["bias"]
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 2, 2, 1),
+                              (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+    return x @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+
+
+def loss_fn(params, batch):
+    logits = forward(params, batch["image"])
+    labels = batch["label"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, acc
+
+
+def synthetic_batch(rng, batch_size=64):
+    """Deterministic synthetic MNIST-shaped data (class-dependent means) so
+    tests can verify learning without dataset downloads (zero egress)."""
+    kx, ky = jax.random.split(rng)
+    labels = jax.random.randint(ky, (batch_size,), 0, 10)
+    base = jax.random.normal(kx, (batch_size, 28, 28, 1)) * 0.1
+    pattern = jnp.linspace(0, 1, 28 * 28).reshape(28, 28, 1)
+    x = base + (labels[:, None, None, None] / 10.0) * pattern[None]
+    return {"image": x, "label": labels}

@@ -1,0 +1,199 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+The hot op of the transformer stack, built TPU-first (MXU-sized tiles,
+VMEM-resident accumulators, bf16 in / f32 accumulate).  Replaces what the
+reference delegates to torch/CUDA (scaled_dot_product_attention inside user
+train loops); here it is a framework op reused by models, ring attention
+(`ray_tpu/parallel/ring_attention.py`) and serving.
+
+Forward: pallas kernel, grid (batch*heads, q_blocks), inner fori over k
+blocks with running (max, sum, acc).  Causal variant stops the inner loop at
+the diagonal block.  Backward: custom_vjp recomputing probabilities from the
+saved logsumexp (flash-style recompute; O(S^2) inside XLA, fused).
+
+On non-TPU backends the same kernel runs in interpret mode for tiny shapes
+(tests), and a pure-XLA reference path is used otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (bq, d)
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        last = jnp.minimum(num_k_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        last = num_k_blocks
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kj, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    d = q_ref.shape[-1]
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, m, l = jax.lax.fori_loop(0, last, body, init)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)  # (bq, 1)
+
+
+def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, S // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=S,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(B, H, S, D), lse.reshape(B, H, S)
+
+
+def _reference_attention(q, k, v, sm_scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def _use_pallas(q, block_q, block_k) -> Optional[bool]:
+    """None = no pallas at all; True = compiled; False = interpret mode."""
+    if not _HAS_PLTPU:
+        return None
+    S = q.shape[2]
+    if S % block_q or S % block_k:
+        return None
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # interpret mode is only worth it for test-sized shapes
+        return False if q.size <= (1 << 16) else None
+    return True
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=128, block_k=128):
+    """Multi-head attention over (batch, heads, seq, head_dim) tensors."""
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    S = q.shape[2]
+    bq, bk = min(block_q, S), min(block_k, S)
+    mode = _use_pallas(q, bq, bk)
+    if mode is None:
+        o, lse = _reference_attention(q, k, v, scale, causal)
+    else:
+        o, lse = _pallas_forward(q, k, v, scale, causal, bq, bk,
+                                 interpret=not mode)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha(q, k, v, causal=False, sm_scale=None):
+    """Attention over (batch, seq, heads, head_dim) layout (model-friendly)."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = flash_attention(qt, kt, vt, causal, sm_scale)
+    return o.transpose(0, 2, 1, 3)

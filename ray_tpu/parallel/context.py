@@ -1,0 +1,40 @@
+"""Process-wide mesh context.
+
+Models need the mesh at trace time to wrap sequence-parallel attention in
+shard_map; threading it through every call signature is noisy, so the Train
+layer (and tests) bind it here around trace/compile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_state = threading.local()
+
+
+def get_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def require_mesh() -> Mesh:
+    mesh = get_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "no mesh bound — wrap the call in `with use_mesh(mesh):` "
+            "(the Train layer does this automatically)"
+        )
+    return mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    prev = get_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev

@@ -1,0 +1,125 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+Greenfield capability vs the reference (verified absent there — SURVEY.md
+§2.6: no ring-attention/Ulysses/sequence-parallel anywhere in `python/` or
+`rllib/`).  Design:
+
+  * ``ring_attention`` — inside-shard_map attention where each device holds a
+    sequence chunk of Q/K/V; K/V chunks rotate around the ``sp`` mesh axis via
+    ``lax.ppermute`` while each device accumulates online-softmax partial
+    results for its local queries.  Communication rides the ICI ring and
+    overlaps with the per-step attention compute under XLA's async collective
+    scheduling.
+  * ``ulysses_attention`` — all-to-all alternative: reshard seq→heads, run
+    the local flash kernel on full sequences of a head subset, reshard back.
+
+Both compose with the Pallas flash kernel (`ray_tpu/ops/flash_attention.py`)
+for the per-chunk compute.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Attention over sequence-sharded q/k/v — call INSIDE shard_map/jit.
+
+    Shapes per device: (batch, heads, seq_chunk, head_dim).
+    """
+    B, H, Sq, D = q.shape
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    def step(i, carry):
+        acc, m, l, kc, vc = carry
+        src = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my_idx * Sq + lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0)
+            k_pos = src * Sq + lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, jnp.exp(s - m_safe))
+        alpha = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return acc_new, m_new, l_new, kc, vc
+
+    init = (
+        jnp.zeros((B, H, Sq, D), jnp.float32),
+        jnp.full((B, H, Sq, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((B, H, Sq, 1), jnp.float32),
+    )
+    acc, m, l, _, _ = lax.fori_loop(0, axis_size, step, init + (k, v))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = True,
+                           sm_scale: Optional[float] = None,
+                           batch_axes=("dp", "fsdp"), seq_axis="sp",
+                           head_axis="tp", variant: str = "ring"):
+    """shard_map wrapper: q/k/v are (batch, heads, seq, head_dim) global
+    arrays; seq sharded on `sp`, heads on `tp`, batch on dp/fsdp."""
+    batch = tuple(a for a in batch_axes if a in mesh.shape and mesh.shape[a] > 1)
+    bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    hspec = head_axis if head_axis in mesh.shape and mesh.shape[head_axis] > 1 else None
+    sspec = seq_axis if seq_axis in mesh.shape and mesh.shape[seq_axis] > 1 else None
+    spec = P(bspec, hspec, sspec, None)
+
+    if sspec is None:
+        # no sequence sharding: plain flash attention
+        return flash_attention(q, k, v, causal, sm_scale)
+
+    inner = ring_attention if variant == "ring" else ulysses_attention
+    fn = functools.partial(inner, axis_name=seq_axis, causal=causal,
+                           sm_scale=sm_scale)
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                      sm_scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism — call
+    inside shard_map.  Per device in: (B, H, S/n, D); internally reshards to
+    (B, H/n, S, D), runs dense flash attention, and reshards back."""
+    B, H, Sq, D = q.shape
+    n = lax.psum(1, axis_name)
+    if H % n:
+        raise ValueError(f"num heads {H} must divide by sp axis size {n}")
+
+    def to_heads(x):
+        # (B, H, S/n, D) -> (B, H/n, S, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):
+        # (B, H/n, S, D) -> (B, H, S/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = flash_attention(qh, kh, vh, causal, sm_scale)
+    return to_seq(oh)

@@ -1,0 +1,175 @@
+"""ShardingConfig: declarative parallelism strategy → concrete shardings.
+
+The TPU-native replacement for the reference's strategy knobs
+(`prepare_model(parallel_strategy="ddp"|"fsdp")`,
+`python/ray/train/torch/train_loop_utils.py:75-104`) — plus the strategies
+the reference lacks natively (TP/PP/SP/EP; SURVEY.md §2.6): here they are
+first-class axis sizes, and "wrapping a model" becomes assigning
+`NamedSharding`s to a pytree of params by logical-dimension rules.
+
+Logical dims used by the bundled models (ray_tpu/models/*):
+  "batch"   → (dp, fsdp)     activations' leading dim
+  "seq"     → sp             sequence dim of activations
+  "embed"   → fsdp           model width when it's the param *sharded* dim
+  "mlp"     → tp             hidden/ffn dim
+  "heads"   → tp             attention head dim
+  "kv"      → None           per-head dim (never sharded)
+  "vocab"   → tp             embedding vocab dim
+  "expert"  → ep             MoE expert dim
+  "stage"   → pp             pipeline-stacked leading dim
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import create_mesh
+
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    None: None,
+}
+
+
+@dataclass
+class ShardingConfig:
+    """Axis sizes for the device mesh.  -1 = all remaining devices."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+    rules: Dict[str, Any] = field(default_factory=lambda: dict(DEFAULT_RULES))
+
+    def axes(self) -> Dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "pp": self.pp,
+                 "sp": self.sp, "ep": self.ep, "tp": self.tp}
+        return {k: v for k, v in sizes.items() if v != 1 or k == "dp"}
+
+    def build_mesh(self, devices=None) -> Mesh:
+        return create_mesh(self.axes(), devices=devices)
+
+    # ------------------------------------------------------------------
+
+    def _resolve(self, logical: Optional[str], mesh: Mesh):
+        axis = self.rules.get(logical, None)
+        if axis is None:
+            return None
+        if isinstance(axis, (tuple, list)):
+            present = tuple(a for a in axis if a in mesh.shape and mesh.shape[a] > 1)
+            if not present:
+                return None
+            return present if len(present) > 1 else present[0]
+        if axis in mesh.shape and mesh.shape[axis] > 1:
+            return axis
+        return None
+
+    def spec(self, mesh: Mesh, *logical_dims: Optional[str]) -> P:
+        # A mesh axis may appear only once in a PartitionSpec; earlier dims
+        # win (so "batch" on (dp, fsdp) suppresses "embed" on fsdp for
+        # activations — params without a batch dim still shard on fsdp).
+        used: set = set()
+        parts = []
+        for d in logical_dims:
+            axis = self._resolve(d, mesh)
+            if axis is None:
+                parts.append(None)
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            axes = tuple(a for a in axes if a not in used)
+            used.update(axes)
+            parts.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+        return P(*parts)
+
+    def named_sharding(self, mesh: Mesh, *logical_dims) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(mesh, *logical_dims))
+
+    def shard_pytree(self, mesh: Mesh, logical_tree) -> Any:
+        """Map a pytree of logical-dim tuples to NamedShardings."""
+        return jax.tree.map(
+            lambda dims: self.named_sharding(mesh, *dims),
+            logical_tree,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+
+    def constraint(self, x, mesh: Mesh, *logical_dims):
+        """with_sharding_constraint by logical dims (inside jit)."""
+        return jax.lax.with_sharding_constraint(
+            x, self.named_sharding(mesh, *logical_dims)
+        )
+
+
+def infer_param_logical_dims(path: Tuple[str, ...], shape: Tuple[int, ...]):
+    """Heuristic logical dims for a transformer param by its name path.
+
+    Mirrors how t5x/maxtext-style logical axis rules classify params; used
+    when a model doesn't annotate its params explicitly.
+    """
+    name = "/".join(str(p) for p in path).lower()
+    nd = len(shape)
+    if nd == 0:
+        return ()
+    if "embedding" in name or "wte" in name or "embed_tokens" in name:
+        return ("vocab", "embed")[:nd] if nd >= 2 else ("embed",)
+    if "wpe" in name or "pos_emb" in name:
+        return (None, "embed")[:nd] if nd >= 2 else ("embed",)
+    if any(k in name for k in ("ln", "layernorm", "layer_norm", "norm",
+                               "scale", "bias", "rmsnorm")) and nd == 1:
+        return (None,)
+    if any(k in name for k in ("q_proj", "k_proj", "v_proj", "qkv", "c_attn",
+                               "wq", "wk", "wv", "query", "key", "value")):
+        return ("embed", "heads") if nd == 2 else ("embed", "heads", "kv")[:nd]
+    if any(k in name for k in ("o_proj", "c_proj/attn", "attn/c_proj", "wo",
+                               "out_proj")):
+        return ("heads", "embed")[:nd]
+    if any(k in name for k in ("up_proj", "gate_proj", "c_fc", "wi", "fc1",
+                               "mlp_in")):
+        return ("embed", "mlp")[:nd]
+    if any(k in name for k in ("down_proj", "wo_mlp", "c_proj", "fc2", "wo2",
+                               "mlp_out")):
+        return ("mlp", "embed")[:nd]
+    if "lm_head" in name:
+        return ("embed", "vocab")[:nd]
+    if nd == 2:
+        return ("embed", "mlp")
+    if nd == 1:
+        return (None,)
+    return tuple([None] * nd)
+
+
+def shard_params(params, config: ShardingConfig, mesh: Mesh):
+    """Device-put a param pytree according to inferred logical dims."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(getattr(k, "key", getattr(k, "idx", str(k))) for k in path)
+        dims = infer_param_logical_dims(keys, getattr(leaf, "shape", ()))
+        sh = config.named_sharding(mesh, *dims) if dims else NamedSharding(mesh, P())
+        out.append(jax.device_put(leaf, sh))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def param_shardings(params, config: ShardingConfig, mesh: Mesh):
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        keys = tuple(getattr(k, "key", getattr(k, "idx", str(k))) for k in path)
+        dims = infer_param_logical_dims(keys, getattr(leaf, "shape", ()))
+        out.append(config.named_sharding(mesh, *dims) if dims
+                   else NamedSharding(mesh, P()))
+    return jax.tree_util.tree_unflatten(treedef, out)

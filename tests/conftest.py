@@ -10,6 +10,7 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: env may pin the TPU platform
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # don't register the TPU tunnel
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +20,13 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# sitecustomize may have force-registered the TPU platform programmatically
+# before this file ran; pin the config back to CPU (backends aren't
+# initialized yet at collection time, so this is still effective).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
