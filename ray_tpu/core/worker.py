@@ -140,17 +140,31 @@ class Worker:
             from ray_tpu.core.raylet import SimpleFuture
 
             fut = SimpleFuture()
-            self.raylet.call_async(self.raylet.async_get, ids, fut.set)
+            cancel_fut = self.raylet.call(self.raylet.async_get, ids, fut.set)
             try:
                 results = fut.result(timeout)
             except TimeoutError:
+                # Deregister the waiters we left behind in the raylet.
+                def _cancel():
+                    try:
+                        cancel = cancel_fut.result(0)
+                    except Exception:  # noqa: BLE001
+                        return
+                    if cancel is not None:
+                        cancel()
+                self.raylet.call_async(_cancel)
                 raise GetTimeoutError(
                     f"get() timed out after {timeout}s"
                 ) from None
         else:
-            results = self._request(
-                "get", ids=[i.hex() for i in ids], timeout=timeout
-            )
+            try:
+                results = self._request(
+                    "get", ids=[i.hex() for i in ids], _wait_timeout=timeout
+                )
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out after {timeout}s"
+                ) from None
         out = []
         for oid in ids:
             kind, *rest = results[oid.hex()]

@@ -11,12 +11,15 @@ still receive its reply; the main thread is the single task executor.
 from __future__ import annotations
 
 import argparse
+import asyncio
+import inspect
 import os
 import queue
 import socket
 import sys
 import threading
 import traceback
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
 import cloudpickle
@@ -42,6 +45,10 @@ class RemoteWorker(Worker):
         self.sock = sock
         self.send_lock = threading.Lock()
         self.task_queue: "queue.Queue" = queue.Queue()
+        # Actor concurrency (reference: threaded concurrency groups + asyncio
+        # actors, `src/ray/core_worker/transport/concurrency_group_manager.cc`)
+        self.actor_executor: Optional[ThreadPoolExecutor] = None
+        self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
         self._rid = 0
         self._rid_lock = threading.Lock()
         self._pending: Dict[int, dict] = {}
@@ -70,14 +77,21 @@ class RemoteWorker(Worker):
     def _send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
 
-    def _request(self, op, **fields):
+    def _request(self, op, _wait_timeout=None, **fields):
+        """Round-trip to the raylet.  ``_wait_timeout`` bounds the local wait
+        (used by get/wait with a user timeout): on expiry the request is
+        cancelled raylet-side and TimeoutError raised here."""
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
         entry = {"event": threading.Event(), "msg": None}
         self._pending[rid] = entry
         self._send({"t": "request", "rid": rid, "op": op, **fields})
-        entry["event"].wait()
+        if not entry["event"].wait(_wait_timeout):
+            self._pending.pop(rid, None)
+            self._send({"t": "request", "rid": rid + (1 << 62), "op":
+                        "cancel_request", "target_rid": rid})
+            raise TimeoutError(f"request {op} timed out")
         msg = entry["msg"]
         if not msg["ok"]:
             raise msg["error"]
@@ -148,6 +162,47 @@ def _apply_runtime_env(spec: TaskSpec):
             sys.path.insert(0, wd)
 
 
+def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):
+    """After actor instantiation: start the thread pool / asyncio loop that
+    back max_concurrency>1 and coroutine methods."""
+    inst = worker.actor_instance
+    # Walk the class MRO rather than getattr on the instance: getattr would
+    # EXECUTE properties as a side effect of actor creation.
+    has_async = any(
+        inspect.iscoroutinefunction(v)
+        for klass in type(inst).__mro__
+        for v in vars(klass).values()
+    )
+    if has_async and worker.actor_loop is None:
+        loop = asyncio.new_event_loop()
+        threading.Thread(target=loop.run_forever, daemon=True,
+                         name="actor-asyncio").start()
+        worker.actor_loop = loop
+    if spec.max_concurrency > 1 and worker.actor_executor is None:
+        worker.actor_executor = ThreadPoolExecutor(
+            max_workers=spec.max_concurrency, thread_name_prefix="actor-exec"
+        )
+
+
+async def _execute_async(worker: RemoteWorker, msg: dict):
+    spec: TaskSpec = msg["spec"]
+    try:
+        args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
+        result = await getattr(worker.actor_instance, spec.method_name)(
+            *args, **kwargs
+        )
+        inline, stored = _package_results(worker, spec, result)
+        worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
+                      "inline": inline, "stored": stored})
+    except Exception:  # noqa: BLE001
+        tb = traceback.format_exc()
+        err = TaskError(spec.name, tb, None)
+        worker._send({
+            "t": "done", "task_id": spec.task_id, "ok": False,
+            "error": err, "retryable": spec.retry_exceptions,
+        })
+
+
 def execute_task(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
     try:
@@ -157,6 +212,7 @@ def execute_task(worker: RemoteWorker, msg: dict):
             cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
             worker.actor_instance = cls(*args, **kwargs)
             worker.current_actor_id = spec.actor_id
+            _setup_actor_concurrency(worker, spec)
             result = None
         elif spec.kind == ACTOR_TASK:
             if spec.method_name == "__ray_terminate__":
@@ -168,7 +224,14 @@ def execute_task(worker: RemoteWorker, msg: dict):
             inst = worker.actor_instance
             if inst is None:
                 raise RuntimeError("actor instance missing")
-            result = getattr(inst, spec.method_name)(*args, **kwargs)
+            method = getattr(inst, spec.method_name)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                # Coroutine reached the sync path (e.g. called from an
+                # executor thread): run it on the actor loop to completion.
+                result = asyncio.run_coroutine_threadsafe(
+                    result, worker.actor_loop
+                ).result() if worker.actor_loop else asyncio.run(result)
         else:
             fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
             result = fn(*args, **kwargs)
@@ -204,6 +267,22 @@ def main():
     })
     while True:
         msg = worker.task_queue.get()
+        spec: TaskSpec = msg["spec"]
+        if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
+                and spec.method_name != "__ray_terminate__"):
+            method = getattr(worker.actor_instance, spec.method_name, None)
+            if worker.actor_loop is not None and \
+                    inspect.iscoroutinefunction(method):
+                # Async actor: schedule on the loop, keep draining the queue
+                # — calls interleave at await points (up to max_concurrency
+                # in flight, bounded raylet-side).
+                asyncio.run_coroutine_threadsafe(
+                    _execute_async(worker, msg), worker.actor_loop
+                )
+                continue
+            if worker.actor_executor is not None:
+                worker.actor_executor.submit(execute_task, worker, msg)
+                continue
         execute_task(worker, msg)
 
 

@@ -8,8 +8,10 @@ train loops); here it is a framework op reused by models, ring attention
 
 Forward: pallas kernel, grid (batch*heads, q_blocks), inner fori over k
 blocks with running (max, sum, acc).  Causal variant stops the inner loop at
-the diagonal block.  Backward: custom_vjp recomputing probabilities from the
-saved logsumexp (flash-style recompute; O(S^2) inside XLA, fused).
+the diagonal block.  Backward: TWO pallas kernels (FlashAttention-2 split):
+a dq kernel blocked over q rows and a dk/dv kernel blocked over k columns,
+both recomputing probabilities tile-by-tile from the saved logsumexp — the
+S×S matrix never exists in HBM in either pass.
 
 On non-TPU backends the same kernel runs in interpret mode for tiny shapes
 (tests), and a pure-XLA reference path is used otherwise.
@@ -117,6 +119,165 @@ def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sm_scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # (bq, d)
+    do = do_ref[0].astype(jnp.float32)        # (bq, d)
+    lse = lse_ref[0]                          # (bq, 1) f32
+    delta = delta_ref[0]                      # (bq, 1) f32
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        last = jnp.minimum(num_k_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        last = num_k_blocks
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(kj, acc):
+        k = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                           # (bq, bk)
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                   # masked lanes underflow to 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                       # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    d = q_ref.shape[-1]
+    acc = jax.lax.fori_loop(0, last, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
+                    seq_len):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    v = v_ref[0].astype(jnp.float32)          # (bk, d)
+
+    num_q_blocks = pl.cdiv(seq_len, block_q)
+    # Causal: q rows strictly above this k column's diagonal see no gradient.
+    start = (kj * block_k) // block_q if causal else 0
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]     # (bq, 1)
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                           # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                       # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                       # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                       # (bk, d)
+        return dk_acc, dv_acc
+
+    d = k_ref.shape[-1]
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk_acc, dv_acc = jax.lax.fori_loop(start, num_q_blocks, body, init)
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+                     interpret):
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    dof = do.reshape(B * H, S, D)
+    lsef = lse.reshape(B * H, S, 1)
+    # delta = rowsum(do * o): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(B * H, S, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=S,
+        ),
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=S,
+        ),
+        grid=(B * H, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D))
+
+
 def _reference_attention(q, k, v, sm_scale, causal):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
@@ -168,6 +329,12 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    S = q.shape[2]
+    bq, bk = min(block_q, S), min(block_k, S)
+    mode = _use_pallas(q, bq, bk)
+    if mode is not None:
+        return _pallas_backward(q, k, v, o, lse, do, scale, causal, bq, bk,
+                                interpret=not mode)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)

@@ -1,0 +1,46 @@
+"""ray_tpu.train — distributed training orchestration.
+
+Reference analogue: `python/ray/train/` (`BaseTrainer.fit`
+`base_trainer.py:570`, `DataParallelTrainer` `data_parallel_trainer.py:58`,
+`BackendExecutor` `_internal/backend_executor.py:45`, `WorkerGroup`
+`_internal/worker_group.py:100`, session `_internal/session.py:84`), rebuilt
+TPU-first: the backend bootstraps ONE multi-process jax runtime across the
+worker group (see `ray_tpu/train/backend.py`) instead of a NCCL process
+group, and all parallelism strategies are jax shardings over the resulting
+global mesh.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingWorkerError
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_local_rank,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    TrainingFailedError,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
+    "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "JaxBackend",
+    "JaxConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "TrainingFailedError", "TrainingWorkerError", "WorkerGroup",
+    "get_checkpoint", "get_context", "get_dataset_shard", "get_local_rank",
+    "get_world_rank", "get_world_size", "report",
+]

@@ -1,0 +1,175 @@
+"""Training backends — the tensor-plane bootstrap.
+
+Reference analogue: `python/ray/train/backend.py` (``Backend``/
+``BackendConfig``) + `python/ray/train/torch/config.py:69-170`
+(``_setup_torch_process_group``: rank-0 address broadcast →
+``dist.init_process_group(nccl|gloo)``).
+
+TPU-native replacement: the worker group elects rank 0 as the JAX
+coordination-service host and every worker calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` —
+after which ``jax.devices()`` is the GLOBAL device list and a single
+``jax.sharding.Mesh`` spans every chip of every worker; XLA inserts the
+collectives (psum/all-gather over ICI/DCN) that NCCL provided in the
+reference.  On CPU (tests) the cross-process data plane is gloo
+(``jax_cpu_collectives_implementation``) with
+``--xla_force_host_platform_device_count`` virtual devices per worker —
+the single-machine analogue of the reference's fake multi-node cluster.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class BackendConfig:
+    """Base config; subclasses name their backend class."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: BackendConfig):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# JAX backend
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Bootstrap a multi-process JAX runtime over the worker group.
+
+    ``distributed=False`` skips ``jax.distributed.initialize`` (single-worker
+    training or externally-initialized runtimes).  ``platform`` pins
+    JAX_PLATFORMS in the workers ("cpu" for the virtual-device test path;
+    None = whatever the worker env provides, i.e. the TPU chips visible to
+    the process on real hardware).  ``devices_per_worker`` sets
+    ``--xla_force_host_platform_device_count`` (CPU testing only).
+    """
+
+    distributed: bool = True
+    platform: Optional[str] = None
+    devices_per_worker: Optional[int] = None
+    coordinator_port: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return JaxBackend
+
+    def worker_env(self) -> Dict[str, str]:
+        """Env vars that must be staged BEFORE the worker process first
+        imports jax (they are read at import/backend-init time)."""
+        env: Dict[str, str] = {}
+        if self.platform:
+            env["JAX_PLATFORMS"] = self.platform
+        if self.devices_per_worker:
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count="
+                f"{self.devices_per_worker}"
+            )
+        return env
+
+
+def _find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_host_and_port(port: Optional[int]):
+    return socket.gethostname(), (port or _find_free_port())
+
+
+def _init_jax_distributed(coordinator: str, world_size: int, rank: int,
+                          platform: Optional[str]):
+    """Runs inside each training worker process."""
+    import os
+
+    import jax
+
+    # NOTE: must not touch jax.devices()/default_backend() before
+    # distributed.initialize — that would create the backend early and the
+    # process would never see the global mesh.
+    env_platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    if platform == "cpu" or (platform is None and env_platform == "cpu"):
+        # Cross-process CPU collectives need gloo (the CPU analogue of the
+        # ICI/DCN data plane).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jax: flag absent
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world_size,
+        process_id=rank,
+    )
+    return {
+        "process_index": jax.process_index(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+    }
+
+
+def _shutdown_jax_distributed():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        if not backend_config.distributed or len(worker_group) == 1:
+            # Single process: nothing to bootstrap; jax picks up the local
+            # devices on first use.
+            return
+        # Elect rank 0's host as coordinator (reference broadcasts rank-0's
+        # address the same way, `train/torch/config.py:102-136`).
+        host, port = worker_group.execute_single(
+            0, _get_host_and_port, backend_config.coordinator_port
+        )
+        coordinator = f"{host}:{port}"
+        results = [None] * len(worker_group)
+        futures = []
+        for rank, w in enumerate(worker_group.workers):
+            futures.append(w.execute.remote(
+                _init_jax_distributed, coordinator, len(worker_group), rank,
+                backend_config.platform,
+            ))
+        import ray_tpu
+
+        results = ray_tpu.get(futures, timeout=300)
+        expect = results[0]["global_devices"]
+        for rank, r in enumerate(results):
+            if r["global_devices"] != expect:
+                raise RuntimeError(
+                    f"worker {rank} sees {r['global_devices']} global devices"
+                    f", rank 0 sees {expect}"
+                )
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: JaxConfig):
+        if backend_config.distributed and len(worker_group) > 1:
+            try:
+                worker_group.execute(_shutdown_jax_distributed)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,136 @@
+"""Actor concurrency (threaded + asyncio), pending placement groups, and
+object-eviction semantics added in round 2.
+
+Reference analogues: `python/ray/tests/test_actor_group.py` concurrency
+cases, `src/ray/core_worker/transport/concurrency_group_manager.cc`
+(threaded/async execution), PG pending semantics
+(`gcs_placement_group_manager.cc`).
+"""
+
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray(request):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_threaded_actor_max_concurrency(ray):
+    @ray.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, d):
+            time.sleep(d)
+            return d
+
+    s = Sleeper.remote()
+    start = time.monotonic()
+    ray.get([s.nap.remote(0.5) for _ in range(4)])
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.5, f"4x0.5s calls at concurrency 4 took {elapsed}"
+
+
+def test_actor_default_is_serial(ray):
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        def bump(self):
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            time.sleep(0.05)
+            self.active -= 1
+            return self.max_active
+
+    c = Counter.remote()
+    results = ray.get([c.bump.remote() for _ in range(5)])
+    assert max(results) == 1, "default actors must execute one call at a time"
+
+
+def test_async_actor(ray):
+    @ray.remote(max_concurrency=8)
+    class AsyncWorker:
+        async def echo(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.3)
+            return x * 2
+
+    a = AsyncWorker.remote()
+    start = time.monotonic()
+    out = ray.get([a.echo.remote(i) for i in range(6)])
+    elapsed = time.monotonic() - start
+    assert out == [i * 2 for i in range(6)]
+    # 6 x 0.3s sleeps must interleave on the event loop
+    assert elapsed < 1.5, f"async calls did not interleave: {elapsed}"
+
+
+def test_pending_pg_activates_when_resources_free(ray):
+    # Module fixture gives 8 CPUs. Hold 6 with a PG, ask for another 6:
+    # second PG must stay pending, then activate once the first is removed.
+    pg1 = ray.placement_group([{"CPU": 6}])
+    assert ray.get(pg1.ready(), timeout=10) is True
+    pg2 = ray.placement_group([{"CPU": 6}])
+    ready, _ = ray.wait([pg2.ready()], num_returns=1, timeout=0.5)
+    assert not ready, "pg2 must be pending while pg1 holds the resources"
+    avail = ray.available_resources()
+    assert avail.get("CPU", 0) >= 0, f"availability went negative: {avail}"
+    ray.remove_placement_group(pg1)
+    assert ray.get(pg2.ready(), timeout=10) is True
+    ray.remove_placement_group(pg2)
+
+
+def test_remove_pending_pg_unblocks_waiters(ray):
+    pg1 = ray.placement_group([{"CPU": 6}])
+    assert pg1.wait(10)
+    pg2 = ray.placement_group([{"CPU": 6}])
+    ray.remove_placement_group(pg2)
+    assert pg2.wait(5) is False  # errored, not hung
+    ray.remove_placement_group(pg1)
+
+
+def test_oversubscribed_pg_rejected(ray):
+    with pytest.raises(ValueError):
+        ray.placement_group([{"CPU": 64}])
+
+
+def test_worker_get_timeout(ray):
+    @ray.remote
+    def waiter():
+        import ray_tpu
+        from ray_tpu.core.exceptions import GetTimeoutError
+
+        @ray_tpu.remote
+        def never_ready():
+            time.sleep(60)
+
+        ref = never_ready.remote()
+        t0 = time.monotonic()
+        try:
+            ray_tpu.get(ref, timeout=1.0)
+            return "no-timeout"
+        except GetTimeoutError:
+            return ("timeout", time.monotonic() - t0)
+
+    kind, elapsed = ray.get(waiter.remote(), timeout=30)
+    assert kind == "timeout"
+    assert elapsed < 5.0, f"worker-mode get timeout took {elapsed}s"
+
+
+def test_evicted_object_raises_object_lost(ray):
+    import numpy as np
+
+    # Store is 256MB (conftest). Put objects until eviction, then get the
+    # first: must raise ObjectLostError promptly, not hang.
+    first = ray.put(np.ones(8 << 20))  # 64 MB
+    refs = [ray.put(np.ones(8 << 20)) for _ in range(4)]  # evicts `first`
+    with pytest.raises(ray.ObjectLostError):
+        ray.get(first, timeout=10)
+    del refs
