@@ -146,6 +146,9 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None, fetch_local: bool = True):
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
+    if len(set(refs)) != len(refs):
+        # Reference semantics: duplicate refs make num_returns ambiguous.
+        raise ValueError("wait() got duplicate ObjectRefs")
     if num_returns <= 0 or num_returns > len(refs):
         raise ValueError(f"num_returns must be in [1, {len(refs)}]")
     return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)

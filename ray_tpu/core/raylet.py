@@ -846,6 +846,14 @@ class Raylet:
         for spec in interrupted:
             for oid in spec.return_ids():
                 self._object_error(oid, err)
+        # The creation task's return object lives in conn.inflight (not
+        # actor.inflight) while the ACTOR_CREATION_TASK runs — if the worker
+        # died mid-creation it would stay pending forever and any get() on
+        # the actor-readiness ref would hang.  Error it unless creation
+        # already resolved it.
+        for oid in actor.creation_spec.return_ids():
+            if self._object_status(oid) not in ("inline", "store", "error"):
+                self._object_error(oid, err)
         while actor.queue:
             spec = actor.queue.popleft()
             for oid in spec.return_ids():
@@ -1037,6 +1045,11 @@ class Raylet:
     def async_wait(self, ids: List[ObjectID], num_returns: int,
                    timeout: Optional[float], done_cb: Callable[[List[str]], None]):
         """Returns a cancel callable (or None if done synchronously)."""
+        # Dedup: the same callback registered once per duplicate id would
+        # count a single object's readiness multiple times toward
+        # num_returns (reference rejects duplicate refs in ray.wait).
+        ids = list(dict.fromkeys(ids))
+        num_returns = min(num_returns, len(ids))
         ready: List[str] = []
         fired = [False]
         pending: List[ObjectID] = []
@@ -1114,7 +1127,63 @@ class Raylet:
         pg = self._pgs.pop(pg_id, None)
         if pg is None:
             return
+        removed_err = ValueError(f"placement group {pg_id} was removed")
+        # Tasks targeting this PG could never schedule again — fail them
+        # now instead of deferring forever.  Both the ready queue and the
+        # dep-blocked table must be purged: a waiting task would re-enter
+        # the ready queue after this purge and then defer on every
+        # _schedule pass.
+        # Collect victims first: _object_error re-enters _schedule, which
+        # mutates the ready queue — never error while iterating it.
+        victims = [s for s in self._ready_queue
+                   if (s.placement or {}).get("pg") == pg_id]
+        self._ready_queue = deque(
+            s for s in self._ready_queue
+            if (s.placement or {}).get("pg") != pg_id)
+        for task_id, (spec, missing) in list(self._waiting.items()):
+            if (spec.placement or {}).get("pg") != pg_id:
+                continue
+            del self._waiting[task_id]
+            for m in missing:
+                peers = self._dep_index.get(m)
+                if peers:
+                    peers.discard(task_id)
+            victims.append(spec)
+        for spec in victims:
+            for oid in spec.return_ids():
+                self._object_error(oid, removed_err)
+            self._record_event(spec, "FAILED", pg_removed=True)
         if pg.state == "created":
+            # Reference kills PG-leased workers on removal
+            # (`gcs_placement_group_scheduler.cc` destroys bundle leases):
+            # reclaim actors and running tasks inside the bundles before
+            # returning capacity so the node pool isn't oversubscribed by
+            # processes still running in the removed group.
+            for actor in list(self._actors.values()):
+                if ((actor.creation_spec.placement or {}).get("pg") != pg_id
+                        or actor.state == "dead"):
+                    continue
+                if actor.conn is None:
+                    # Not yet dispatched (pending/restarting): there is no
+                    # process to kill and no EOF will ever arrive — mark it
+                    # dead directly or it hangs in state "pending" forever.
+                    actor.restarts_left = 0
+                    self._on_actor_death(actor.actor_id, "placement group "
+                                         "removed", allow_restart=False)
+                else:
+                    self.kill_actor(actor.actor_id)
+            for conn in list(self._workers.values()):
+                if conn.actor_id is not None:
+                    continue
+                for spec in conn.inflight.values():
+                    if (spec.placement or {}).get("pg") == pg_id:
+                        spec.retries_left = 0
+                        if conn.pid:
+                            try:
+                                os.kill(conn.pid, 9)
+                            except OSError:
+                                pass
+                        break
             _release(self.resources_available, pg.total())
         elif pg.ready_oid is not None:
             # A still-pending PG will never become ready: fail its ready()

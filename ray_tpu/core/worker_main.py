@@ -270,7 +270,16 @@ def main():
         spec: TaskSpec = msg["spec"]
         if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
                 and spec.method_name != "__ray_terminate__"):
-            method = getattr(worker.actor_instance, spec.method_name, None)
+            # Look the attribute up on the class (static MRO walk), never
+            # the instance: instance getattr would execute property getters
+            # on the dispatch thread — the side-effect hazard
+            # _setup_actor_concurrency documents avoiding.  Static lookup
+            # returns raw descriptors, so unwrap them or an async
+            # staticmethod would fail the coroutine check below.
+            method = inspect.getattr_static(
+                type(worker.actor_instance), spec.method_name, None)
+            if isinstance(method, (staticmethod, classmethod)):
+                method = method.__func__
             if worker.actor_loop is not None and \
                     inspect.iscoroutinefunction(method):
                 # Async actor: schedule on the loop, keep draining the queue
