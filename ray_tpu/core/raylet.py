@@ -732,12 +732,24 @@ class Raylet:
             return
         deferred = deque()
         spawn_demand: Dict[str, int] = {}
+        pg_orphans = []  # tasks whose PG no longer exists — fail after drain
         while self._ready_queue:
             spec = self._ready_queue.popleft()
             if self._dep_errored(spec):
                 continue
             pool, need = self._task_resource_pools(spec)
-            if pool is None or not _fits(pool, need):
+            if pool is None:
+                # Distinguish "not schedulable yet" (pending PG, full
+                # bundles → defer) from "never schedulable" (PG removed or
+                # unknown → fail now, else the task defers forever).
+                # _object_error re-enters _schedule, so only collect here.
+                pg_hex = (spec.placement or {}).get("pg")
+                if pg_hex and pg_hex not in self._pgs:
+                    pg_orphans.append(spec)
+                    continue
+                deferred.append(spec)
+                continue
+            if not _fits(pool, need):
                 deferred.append(spec)
                 continue
             profile = self._profile_key(spec)
@@ -750,6 +762,26 @@ class Raylet:
             spec._acquired_pool = pool
             self._dispatch(spec, conn)
         self._ready_queue = deferred
+        for spec in pg_orphans:
+            if spec.kind == ACTOR_CREATION_TASK and \
+                    spec.actor_id in self._actors:
+                # The actor was registered at submit time; erroring only
+                # the creation refs would leave it 'pending' with method
+                # calls queueing forever — mark it dead (same treatment
+                # as remove_pg gives never-dispatched PG actors).
+                actor = self._actors[spec.actor_id]
+                actor.restarts_left = 0
+                self._on_actor_death(
+                    spec.actor_id,
+                    f"placement group {(spec.placement or {}).get('pg')} "
+                    "was removed", allow_restart=False)
+                continue
+            err = ValueError(
+                f"placement group {(spec.placement or {}).get('pg')} "
+                "was removed")
+            for rid in spec.return_ids():
+                self._object_error(rid, err)
+            self._record_event(spec, "FAILED", pg_removed=True)
         # Spawn up to queue-depth workers per profile in one pass (reference
         # pops/starts a worker per pending lease, `worker_pool.h:156`) —
         # capped by node CPUs so a deep queue can't fork-bomb the host.

@@ -270,14 +270,15 @@ def main():
         spec: TaskSpec = msg["spec"]
         if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
                 and spec.method_name != "__ray_terminate__"):
-            # Look the attribute up on the class (static MRO walk), never
-            # the instance: instance getattr would execute property getters
-            # on the dispatch thread — the side-effect hazard
-            # _setup_actor_concurrency documents avoiding.  Static lookup
-            # returns raw descriptors, so unwrap them or an async
-            # staticmethod would fail the coroutine check below.
+            # getattr_static on the INSTANCE: side-effect-free (no property
+            # getters run on the dispatch thread — the hazard
+            # _setup_actor_concurrency documents) AND it sees instance-dict
+            # methods (self.handler = some_async_fn) that a type()-level
+            # lookup would miss, silently demoting them to the blocking
+            # sync path.  Static lookup returns raw descriptors, so unwrap
+            # them or an async staticmethod would fail the coroutine check.
             method = inspect.getattr_static(
-                type(worker.actor_instance), spec.method_name, None)
+                worker.actor_instance, spec.method_name, None)
             if isinstance(method, (staticmethod, classmethod)):
                 method = method.__func__
             if worker.actor_loop is not None and \

@@ -1,0 +1,28 @@
+"""ray_tpu.data — distributed datasets for TPU training ingest.
+
+Reference analogue: `python/ray/data/__init__.py`.  See
+`ray_tpu/data/dataset.py` for the design notes.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "Dataset", "DataIterator",
+    "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files",
+]

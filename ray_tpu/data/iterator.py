@@ -1,0 +1,60 @@
+"""DataIterator — the per-worker view of a dataset shard.
+
+Reference analogue: `python/ray/data/iterator.py` (``DataIterator`` with
+``iter_batches`` / ``iter_torch_batches``).  Train workers receive one of
+these from ``session.get_dataset_shard`` and pull host batches from it; the
+TPU-first addition is ``iter_jax_batches``, which stages each numpy batch
+onto device (optionally sharded over a mesh axis by the caller).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+
+class DataIterator:
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        return self._dataset.iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._dataset.iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True, dtype=None,
+                         device=None) -> Iterator[Any]:
+        """Numpy batches staged to a JAX device (host→HBM transfer)."""
+        import jax
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                out = {k: jnp.asarray(v, dtype=dtype) if v.dtype.kind in "fiub"
+                       else v for k, v in batch.items()}
+            else:
+                out = jnp.asarray(batch, dtype=dtype)
+            if device is not None:
+                out = jax.device_put(out, device)
+            yield out
+
+    def materialize(self):
+        return self._dataset.materialize()
+
+    def count(self) -> int:
+        return self._dataset.count()
+
+    def __iter__(self):
+        return self.iter_rows()
+
+    def __repr__(self):
+        return f"DataIterator({self._dataset!r})"

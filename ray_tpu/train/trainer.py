@@ -74,6 +74,9 @@ class DataParallelTrainer:
         datasets = self._datasets
 
         def split(world_size: int):
+            from ray_tpu.data.dataset import Dataset
+            from ray_tpu.data.iterator import DataIterator
+
             shards_per_rank = [dict() for _ in range(world_size)]
             for name, ds in datasets.items():
                 if hasattr(ds, "split"):
@@ -81,7 +84,13 @@ class DataParallelTrainer:
                 else:  # plain list/iterable: round-robin
                     parts = [ds] * world_size
                 for rank in range(world_size):
-                    shards_per_rank[rank][name] = parts[rank]
+                    shard = parts[rank]
+                    if isinstance(shard, Dataset):
+                        # workers consume shards through the iterator API
+                        # (reference: session.get_dataset_shard returns a
+                        # DataIterator, `python/ray/data/iterator.py`)
+                        shard = DataIterator(shard)
+                    shards_per_rank[rank][name] = shard
             return shards_per_rank
 
         return split

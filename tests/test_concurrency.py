@@ -177,3 +177,69 @@ def test_evicted_object_raises_object_lost(ray):
     with pytest.raises(ray.ObjectLostError):
         ray.get(first, timeout=10)
     del refs
+
+
+def test_task_submitted_after_pg_removal_errors(ray):
+    """A task targeting a PG removed BEFORE submission must fail fast
+    (not defer forever in the scheduler)."""
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    pg = ray.placement_group([{"CPU": 1}])
+    assert ray.get(pg.ready(), timeout=10) is True
+    ray.remove_placement_group(pg)
+
+    @ray.remote(num_cpus=1)
+    def f():
+        return 1
+
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+    ref = f.options(scheduling_strategy=strategy).remote()
+    with pytest.raises(Exception):
+        ray.get(ref, timeout=10)
+
+
+def test_async_actor_instance_dict_method(ray):
+    """Coroutine methods assigned in __init__ (instance dict, invisible to
+    a type()-level getattr_static) must still route to the event loop."""
+
+    @ray.remote(max_concurrency=4)
+    class A:
+        def __init__(self):
+            import asyncio
+
+            async def nap(sec):
+                await asyncio.sleep(sec)
+                return sec
+
+            self.nap = nap
+
+    a = A.remote()
+    ray.get(a.nap.remote(0.01), timeout=30)  # warm
+    t0 = time.perf_counter()
+    ray.get([a.nap.remote(0.4) for _ in range(4)], timeout=30)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"instance-dict async methods ran serially: {dt:.2f}s"
+
+
+def test_actor_submitted_after_pg_removal_dies(ray):
+    """An actor created against an already-removed PG must die (calls
+    error), not sit pending with method calls queueing forever."""
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    pg = ray.placement_group([{"CPU": 1}])
+    assert ray.get(pg.ready(), timeout=10) is True
+    ray.remove_placement_group(pg)
+
+    @ray.remote(num_cpus=1)
+    class A:
+        def m(self):
+            return 1
+
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+    a = A.options(scheduling_strategy=strategy).remote()
+    with pytest.raises((ray.ActorDiedError, ray.RayTpuError, ValueError)):
+        ray.get(a.m.remote(), timeout=10)

@@ -1,0 +1,258 @@
+"""ray_tpu.data — Dataset/blocks/readers/streaming execution.
+
+Reference test analogue: `python/ray/data/tests/test_dataset.py` (creation,
+map/map_batches, split, shuffle, iteration semantics).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray(ray_shared):
+    return ray_shared
+
+
+def test_range_count_take(ray):
+    ds = rd.range(100, parallelism=5)
+    assert ds.count() == 100
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+    assert ds.num_blocks() == 5
+
+
+def test_from_items_rows(ray):
+    ds = rd.from_items([{"x": i, "y": -i} for i in range(10)], parallelism=3)
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert rows[3] == {"x": 3, "y": -3}
+
+
+def test_from_numpy_schema(ray):
+    ds = rd.from_numpy(np.ones((12, 4), np.float32), parallelism=4)
+    assert ds.count() == 12
+    schema = ds.schema()
+    assert schema == {"value": "float32"}
+
+
+def test_map_batches(ray):
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    vals = [r["id"] for r in ds.take_all()]
+    assert vals == [i * 2 for i in range(64)]
+
+
+def test_map_batches_batch_size(ray):
+    seen = []
+
+    def fn(b):
+        # runs in a worker; record batch length via output
+        return {"id": b["id"], "n": np.full(len(b["id"]), len(b["id"]))}
+
+    ds = rd.range(10, parallelism=1).map_batches(fn, batch_size=4)
+    ns = [r["n"] for r in ds.take_all()]
+    assert ns == [4, 4, 4, 4, 4, 4, 4, 4, 2, 2]
+
+
+def test_map_filter_flat_map_fuse(ray):
+    ds = (rd.range(20, parallelism=2)
+          .map(lambda r: {"id": r["id"] + 1})
+          .filter(lambda r: r["id"] % 2 == 0)
+          .flat_map(lambda r: [{"id": r["id"]}, {"id": -r["id"]}]))
+    vals = [r["id"] for r in ds.take_all()]
+    assert vals[:4] == [2, -2, 4, -4]
+    assert len(vals) == 20
+
+
+def test_iter_batches_spans_blocks(ray):
+    ds = rd.range(25, parallelism=4)  # ragged blocks: 7,6,6,6
+    batches = list(ds.iter_batches(batch_size=10))
+    assert [len(b["id"]) for b in batches] == [10, 10, 5]
+    assert list(batches[0]["id"]) == list(range(10))
+    batches = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert [len(b["id"]) for b in batches] == [10, 10]
+
+
+def test_iter_batches_local_shuffle(ray):
+    ds = rd.range(100, parallelism=4)
+    flat = np.concatenate([b["id"] for b in ds.iter_batches(
+        batch_size=10, local_shuffle_buffer_size=50, local_shuffle_seed=0)])
+    assert len(flat) == 100
+    assert set(flat.tolist()) == set(range(100))
+    assert flat.tolist() != list(range(100))
+
+
+def test_split_block_granularity(ray):
+    ds = rd.range(100, parallelism=10)
+    shards = ds.split(3)
+    counts = [s.count() for s in shards]
+    assert sum(counts) == 100
+    assert max(counts) - min(counts) <= 10  # balanced within a block
+    all_ids = sorted(i for s in shards for i in (r["id"] for r in s.take_all()))
+    assert all_ids == list(range(100))
+
+
+def test_split_equal(ray):
+    ds = rd.range(101, parallelism=4)
+    shards = ds.split(4, equal=True)
+    assert [s.count() for s in shards] == [25, 25, 25, 25]
+
+
+def test_repartition(ray):
+    ds = rd.range(30, parallelism=3).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 30
+    assert [r["id"] for r in ds.take_all()] == list(range(30))
+
+
+def test_random_shuffle(ray):
+    ds = rd.range(200, parallelism=4).random_shuffle(seed=7)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(200))
+    assert vals != list(range(200))
+    # deterministic given the seed
+    vals2 = [r["id"] for r in
+             rd.range(200, parallelism=4).random_shuffle(seed=7).take_all()]
+    assert vals == vals2
+
+
+def test_sort(ray):
+    rng = np.random.default_rng(0)
+    items = rng.permutation(50).tolist()
+    ds = rd.from_items([{"v": int(v)} for v in items], parallelism=5)
+    out = [r["v"] for r in ds.sort(key="v").take_all()]
+    assert out == sorted(items)
+    out_desc = [r["v"] for r in ds.sort(key="v", descending=True).take_all()]
+    assert out_desc == sorted(items, reverse=True)
+
+
+def test_union_zip_limit(ray):
+    a = rd.range(10, parallelism=2)
+    b = rd.range(10, parallelism=2).map_batches(lambda x: {"id2": x["id"] + 100})
+    assert a.union(rd.range(5, parallelism=1)).count() == 15
+    z = a.zip(b)
+    rows = z.take_all()
+    assert rows[0] == {"id": 0, "id2": 100}
+    lim = rd.range(100, parallelism=10).limit(13)
+    assert lim.count() == 13
+    assert [r["id"] for r in lim.take_all()] == list(range(13))
+
+
+def test_aggregates(ray):
+    ds = rd.range(10, parallelism=3)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_add_drop_select_columns(ray):
+    ds = (rd.range(5, parallelism=1)
+          .add_column("sq", lambda b: b["id"] ** 2)
+          .add_column("junk", lambda b: b["id"] * 0))
+    assert set(ds.schema().keys()) == {"id", "sq", "junk"}
+    ds2 = ds.drop_columns(["junk"])
+    assert set(ds2.schema().keys()) == {"id", "sq"}
+    ds3 = ds.select_columns(["sq"])
+    assert [r["sq"] for r in ds3.take_all()] == [0, 1, 4, 9, 16]
+
+
+def test_parquet_roundtrip(ray, tmp_path):
+    path = str(tmp_path / "pq")
+    rd.range(40, parallelism=4).map_batches(
+        lambda b: {"id": b["id"], "x": b["id"] * 0.5}).write_parquet(path)
+    assert len(os.listdir(path)) == 4
+    ds = rd.read_parquet(path)
+    assert ds.count() == 40
+    assert ds.schema()["x"] == "float64"
+    assert [r["id"] for r in ds.take(3)] == [0, 1, 2]
+
+
+def test_csv_json_text(ray, tmp_path):
+    csv = tmp_path / "f.csv"
+    csv.write_text("a,b\n1,x\n2,y\n")
+    ds = rd.read_csv(str(csv))
+    assert ds.count() == 2
+    assert ds.take(1)[0]["a"] == 1
+
+    jsonl = tmp_path / "f.jsonl"
+    jsonl.write_text('{"k": 1}\n{"k": 2}\n')
+    assert [r["k"] for r in rd.read_json(str(jsonl)).take_all()] == [1, 2]
+
+    txt = tmp_path / "f.txt"
+    txt.write_text("hello\nworld\n")
+    assert [r["text"] for r in rd.read_text(str(txt)).take_all()] == [
+        "hello", "world"]
+
+
+def test_streaming_is_parallel(ray):
+    """Blocks must execute concurrently (not serially) through the
+    streaming executor."""
+
+    def slow(b):
+        time.sleep(0.4)
+        return b
+
+    ds = rd.range(8, parallelism=8).map_batches(slow)
+    t0 = time.perf_counter()
+    assert ds.count() == 8
+    dt = time.perf_counter() - t0
+    assert dt < 8 * 0.4 * 0.6, f"map tasks look serial: {dt:.2f}s"
+
+
+def test_streaming_bounded_window(ray):
+    """iter_batches must not materialize the whole dataset up front: the
+    first batch arrives before all blocks could possibly have finished."""
+
+    def slow(b):
+        time.sleep(0.3)
+        return b
+
+    ds = rd.range(32, parallelism=16).map_batches(slow)
+    t0 = time.perf_counter()
+    first = next(iter(ds.iter_batches(batch_size=2, prefetch_blocks=4)))
+    dt = time.perf_counter() - t0
+    assert len(first["id"]) == 2
+    assert dt < 16 * 0.3 * 0.5, f"first batch waited for full pipeline: {dt:.2f}s"
+
+
+def test_lazy_plan_does_not_execute_until_consumed(ray):
+    marker = str(time.time())
+
+    def boom(b):
+        raise RuntimeError("should not run " + marker)
+
+    ds = rd.range(4, parallelism=2).map_batches(boom)  # no error yet
+    assert isinstance(repr(ds), str)
+    with pytest.raises(Exception):
+        ds.count()
+
+
+def test_data_iterator_wrapper(ray):
+    from ray_tpu.data import DataIterator
+
+    it = DataIterator(rd.range(16, parallelism=2))
+    batches = list(it.iter_batches(batch_size=8))
+    assert len(batches) == 2
+    jb = list(it.iter_jax_batches(batch_size=8))
+    assert jb[0]["id"].shape == (8,)
+
+
+def test_random_shuffle_single_block(ray):
+    """Regression: parallelism=1 shuffle must not wrap the block in a
+    1-tuple (num_returns=1 stores tuples whole)."""
+    ds = rd.range(5, parallelism=1).random_shuffle(seed=0)
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == [0, 1, 2, 3, 4]
+
+
+def test_sort_all_empty_blocks(ray):
+    ds = rd.from_items([{"v": 1}], parallelism=1).filter(
+        lambda r: False).materialize()
+    ds = ds.union(rd.from_items([{"v": 2}], parallelism=1).filter(
+        lambda r: False).materialize())
+    assert ds.sort(key="v").count() == 0
