@@ -27,7 +27,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu",)
     if on_tpu:
-        batch, seq, steps = 8, 1024, 10
+        batch, seq, steps = 16, 1024, 10
         cfg = gpt2.GPT2_SMALL
     else:  # smoke-test path for CPU-only environments
         batch, seq, steps = 2, 128, 2
@@ -57,6 +57,9 @@ def main():
         dt = time.perf_counter() - t0
         best = max(best, batch * seq * steps / dt)
     tokens_per_sec = best
+    # MFU vs v5e bf16 peak (197 TFLOP/s); count is full fwd+bwd already.
+    flops_per_token = gpt2.count_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_per_token / 197e12
     print(json.dumps({
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
                   else "gpt2_tiny_cpu_smoke_tokens_per_sec",
@@ -64,6 +67,7 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(tokens_per_sec / A100_TOKENS_PER_SEC, 4)
                        if on_tpu else 0.0,
+        "mfu_v5e": round(mfu, 4) if on_tpu else 0.0,
     }))
 
 

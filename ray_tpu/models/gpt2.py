@@ -136,9 +136,10 @@ def _block(x, p, cfg: GPT2Config):
     return x
 
 
-def forward(params, tokens, cfg: GPT2Config):
-    """tokens (B, S) int32 -> logits (B, S, vocab)."""
-    B, S = tokens.shape
+def _trunk(params, tokens, cfg: GPT2Config):
+    """Embedding + transformer blocks + final LN -> (B, S, E) in
+    compute_dtype (the LN itself runs f32 for stability)."""
+    S = tokens.shape[1]
     x = (params["wte"]["embedding"][tokens]
          + params["wpe"]["embedding"][:S][None])
     x = x.astype(cfg.compute_dtype)
@@ -148,18 +149,32 @@ def forward(params, tokens, cfg: GPT2Config):
     for i in range(cfg.n_layer):
         x = block(x, params[f"h_{i}"], cfg)
     x = _layer_norm(x.astype(jnp.float32), params["ln_f"])
-    logits = x @ params["wte"]["embedding"].T
-    return logits
+    return x.astype(cfg.compute_dtype)
+
+
+def forward(params, tokens, cfg: GPT2Config):
+    """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
+    x = _trunk(params, tokens, cfg)
+    # Tied lm head.  The matmul runs in compute_dtype (bf16 MXU path —
+    # an f32 head costs ~30% of model FLOPs at the slow f32 MXU rate);
+    # logits upcast to f32 for the softmax.
+    wte = params["wte"]["embedding"].astype(cfg.compute_dtype)
+    return (x @ wte.T).astype(jnp.float32)
 
 
 def loss_fn(params, batch, cfg: GPT2Config):
-    """batch: {"tokens": (B, S+1)} — next-token cross entropy."""
+    """batch: {"tokens": (B, S+1)} — next-token cross entropy.
+
+    logsumexp form (lse - logit_at_target) rather than materializing
+    log_softmax: one fused reduction over the vocab axis instead of an
+    extra (B, S, V) f32 intermediate in HBM.
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
 
 
 def make_train_step(cfg: GPT2Config, optimizer):
@@ -180,7 +195,12 @@ def num_params(params) -> int:
 
 
 def count_flops_per_token(cfg: GPT2Config, seq_len: int) -> float:
-    """~6N + attention flops per token (PaLM appendix formula)."""
-    n = (12 * cfg.n_layer * cfg.n_embd ** 2 * (1 + 1 / 3)
-         + 2 * cfg.vocab_size * cfg.n_embd)
+    """Training (fwd+bwd) FLOPs per token: 6N + 12*L*E*S (PaLM appendix B).
+
+    N counts matmul params only: 12*L*E^2 for the blocks (c_attn 3E^2 +
+    attn c_proj E^2 + mlp 8E^2) plus V*E for the tied lm head (the
+    embedding gather is not a matmul).  The 6 covers fwd (2) + bwd (4);
+    callers must NOT multiply by 3 again.
+    """
+    n = 12 * cfg.n_layer * cfg.n_embd ** 2 + cfg.vocab_size * cfg.n_embd
     return 6 * n + 12 * cfg.n_layer * cfg.n_embd * seq_len
