@@ -15,6 +15,7 @@ class Result:
     error: Optional[BaseException] = None
     metrics_history: List[Dict[str, Any]] = field(default_factory=list)
     path: Optional[str] = None
+    config: Optional[Dict[str, Any]] = None  # trial config (Tune results)
 
     @property
     def best_checkpoints(self):

@@ -155,11 +155,11 @@ def _trunk(params, tokens, cfg: GPT2Config):
 def forward(params, tokens, cfg: GPT2Config):
     """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
     x = _trunk(params, tokens, cfg)
-    # Tied lm head.  The matmul runs in compute_dtype (bf16 MXU path —
-    # an f32 head costs ~30% of model FLOPs at the slow f32 MXU rate);
-    # logits upcast to f32 for the softmax.
+    # Tied lm head: bf16 operands on the MXU (an f32 head costs ~30% of
+    # model FLOPs at the slow f32 MXU rate) with an f32 accumulate/output
+    # so the softmax sees full-precision logits.
     wte = params["wte"]["embedding"].astype(cfg.compute_dtype)
-    return (x @ wte.T).astype(jnp.float32)
+    return jnp.matmul(x, wte.T, preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, batch, cfg: GPT2Config):

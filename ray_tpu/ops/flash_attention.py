@@ -340,6 +340,12 @@ def _use_pallas(q, block_q, block_k) -> Optional[bool]:
     S = q.shape[2]
     if S % block_q or S % block_k:
         return None
+    # Degenerate blocks (odd/prime S drives _auto_block toward 1): the
+    # dense path beats a grid of sub-tile steps, and sub-8-sublane blocks
+    # risk Mosaic compile errors.  Whole-sequence blocks (bq == S) stay
+    # allowed for short-sequence/decode shapes.
+    if (block_q < 128 and block_q != S) or (block_k < 128 and block_k != S):
+        return None
     platform = jax.devices()[0].platform
     if platform == "cpu":
         # interpret mode is only worth it for test-sized shapes

@@ -1,0 +1,129 @@
+"""Search spaces and variant generation.
+
+Reference analogues: `python/ray/tune/search/sample.py` (Domain/Float/
+Integer/Categorical), `python/ray/tune/search/basic_variant.py`
+(BasicVariantGenerator: grid expansion x num_samples with seeded random
+resolution), `python/ray/tune/search/variant_generator.py`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower),
+                                        math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    """Marker consumed by the variant generator (reference format)."""
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _walk(space: Dict[str, Any], path=()):
+    """Yield (path, value) for every leaf of a nested dict space."""
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            yield from _walk(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def _set_path(d: dict, path, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
+                      seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Expand grid_search axes (cartesian product) and draw
+    ``num_samples`` random resolutions of the Domain leaves for each grid
+    combination (reference: BasicVariantGenerator semantics)."""
+    rng = random.Random(seed)
+    grid_axes = [(p, v["grid_search"]) for p, v in _walk(param_space)
+                 if _is_grid(v)]
+    sample_leaves = [(p, v) for p, v in _walk(param_space)
+                     if isinstance(v, Domain)]
+    const_leaves = [(p, v) for p, v in _walk(param_space)
+                    if not _is_grid(v) and not isinstance(v, Domain)]
+
+    variants = []
+    grid_values = [axis for _, axis in grid_axes]
+    for combo in itertools.product(*grid_values) if grid_axes else [()]:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = {}
+            for p, v in const_leaves:
+                _set_path(cfg, p, v)
+            for (p, _), val in zip(grid_axes, combo):
+                _set_path(cfg, p, val)
+            for p, dom in sample_leaves:
+                _set_path(cfg, p, dom.sample(rng))
+            variants.append(cfg)
+    return variants
