@@ -1,0 +1,117 @@
+"""HTTP ingress — a proxy actor running a threaded stdlib HTTP server.
+
+Reference analogue: `python/ray/serve/_private/http_proxy.py:873`
+(``HTTPProxyActor`` hosting uvicorn+ASGI).  TPU-image constraint: no
+uvicorn/starlette wheels are guaranteed, so ingress is
+``http.server.ThreadingHTTPServer`` — each request thread routes through
+a DeploymentHandle (power-of-two-choices) and blocks on the replica
+response; JSON in, JSON out.
+
+Routes: ``POST/GET <route_prefix>`` dispatches to the app bound at that
+prefix (longest-prefix match); ``GET /-/routes`` lists the table;
+``GET /-/healthz`` liveness.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+PROXY_NAME = "SERVE_PROXY"
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.serve.router import DeploymentHandle
+
+        self._host = host
+        self._port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body: Optional[bytes]):
+                try:
+                    status, payload = proxy._handle(self.path, body)
+                except Exception as e:  # noqa: BLE001
+                    status, payload = 500, json.dumps(
+                        {"error": str(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._dispatch(self.rfile.read(n) if n else None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # ----------------------------------------------------------------
+
+    def _handle(self, path: str, body: Optional[bytes]):
+        import ray_tpu
+
+        path = path.split("?", 1)[0]
+        if path == "/-/healthz":
+            return 200, b'"ok"'
+        if path == "/-/routes":
+            with self._lock:
+                return 200, json.dumps(self._routes).encode()
+        with self._lock:
+            match = None
+            for prefix, deployment in self._routes.items():
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    if match is None or len(prefix) > len(match[0]):
+                        match = (prefix, deployment)
+        if match is None:
+            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+        deployment = match[1]
+        handle = self._get_handle(deployment)
+        request = json.loads(body) if body else None
+        result = ray_tpu.get(handle.remote(request), timeout=120)
+        return 200, json.dumps(result, default=str).encode()
+
+    def _get_handle(self, deployment: str):
+        from ray_tpu.serve.router import DeploymentHandle
+
+        with self._lock:
+            h = self._handles.get(deployment)
+            if h is None:
+                h = self._handles[deployment] = DeploymentHandle(deployment)
+            return h
+
+    # ---------------------------------------------------------------- ctrl
+
+    def update_routes(self, routes: Dict[str, str]):
+        with self._lock:
+            self._routes = dict(routes)
+        return True
+
+    def get_port(self) -> int:
+        return self._port
+
+    def check_health(self) -> bool:
+        return True
+
+    def shutdown(self):
+        self._server.shutdown()
+        return True

@@ -1,0 +1,67 @@
+"""Replica — the actor hosting one copy of a deployment's callable.
+
+Reference analogue: `python/ray/serve/_private/replica.py:447`
+(``RayServeReplica.handle_request``) — minus the Cython/asyncio plumbing:
+requests dispatch through the core actor transport with
+``max_concurrency``, and the replica self-reports its in-flight count for
+the router's power-of-two probes and the controller's autoscaler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+
+class Replica:
+    def __init__(self, deployment_def, init_args, init_kwargs,
+                 user_config: Optional[dict] = None):
+        import cloudpickle
+
+        fn_or_class = cloudpickle.loads(deployment_def)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._start_time = time.time()
+        if isinstance(fn_or_class, type):
+            self._callable = fn_or_class(*init_args, **(init_kwargs or {}))
+        else:
+            self._callable = fn_or_class
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ------------------------------------------------------------- serving
+
+    def handle_request(self, request: Any, method: str = "__call__"):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method == "__call__" and callable(self._callable):
+                fn = self._callable  # plain function or __call__ instance
+            else:
+                fn = getattr(self._callable, method)
+            return fn(request)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # ------------------------------------------------------------- control
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "uptime_s": time.time() - self._start_time}
+
+    def reconfigure(self, user_config: dict):
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            return bool(self._callable.check_health())
+        return True

@@ -1,0 +1,128 @@
+"""DeploymentHandle — routes requests to replicas, power-of-two-choices.
+
+Reference analogues: `python/ray/serve/handle.py:86` (``RayServeHandle``),
+`serve/_private/router.py:244` (``PowerOfTwoChoicesReplicaScheduler``:
+sample two replicas, probe queue lengths, pick the shorter queue —
+`:639,856`).  Config push is poll-based here (the reference long-polls,
+`_private/long_poll.py`): handles refresh their replica set from the
+controller when stale or on miss.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, List, Optional
+
+from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE
+
+_REFRESH_S = 1.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._deployment = deployment_name
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []  # ActorHandles
+        self._fetched_at = 0.0
+        self._version = -1
+
+    # ------------------------------------------------------------- plumbing
+
+    def _controller(self):
+        import ray_tpu
+
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=NAMESPACE)
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+
+        now = time.time()
+        with self._lock:
+            if not force and self._replicas and \
+                    now - self._fetched_at < _REFRESH_S:
+                return
+        routing = ray_tpu.get(self._controller().get_routing.remote(),
+                              timeout=10)
+        entry = routing["deployments"].get(self._deployment)
+        if entry is None:
+            raise ValueError(
+                f"no deployment named {self._deployment!r}")
+        handles = [ray_tpu.get_actor(n, namespace=NAMESPACE)
+                   for n in entry["replicas"]]
+        with self._lock:
+            self._replicas = handles
+            self._fetched_at = now
+            self._version = routing["version"]
+
+    def _pick_replica(self):
+        """Power-of-two-choices (reference `router.py:639`): sample two,
+        probe in-flight counts, route to the less loaded."""
+        import ray_tpu
+
+        self._refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+        deadline = time.time() + 30.0
+        while not replicas:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"deployment {self._deployment!r} has no ready replicas")
+            time.sleep(0.1)
+            self._refresh(force=True)
+            with self._lock:
+                replicas = list(self._replicas)
+        if len(replicas) == 1:
+            a, b = replicas[0], None
+        else:
+            a, b = random.sample(replicas, 2)
+        # The probe doubles as a liveness check: a cached-but-dead replica
+        # (e.g. just replaced by an in-place redeploy) errors here and we
+        # refetch the table instead of handing the caller a dead ref.
+        try:
+            if b is None:
+                ray_tpu.get(a.get_queue_len.remote(), timeout=5.0)
+                return a
+            qa, qb = ray_tpu.get(
+                [a.get_queue_len.remote(), b.get_queue_len.remote()],
+                timeout=5.0)
+        except Exception:  # noqa: BLE001 - stale replica: refetch, retry once
+            self._refresh(force=True)
+            with self._lock:
+                replicas = list(self._replicas)
+            if not replicas:
+                raise RuntimeError(
+                    f"deployment {self._deployment!r} lost its replicas")
+            return random.choice(replicas)
+        return a if qa <= qb else b
+
+    # ------------------------------------------------------------- calling
+
+    def remote(self, request: Any = None):
+        """Dispatch; returns an ObjectRef (resolve with ray_tpu.get)."""
+        replica = self._pick_replica()
+        return replica.handle_request.remote(request, self._method)
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        return DeploymentHandle(self._deployment, method_name)
+
+    @property
+    def method(self):
+        """``handle.method.<name>.remote(x)`` sugar."""
+        return _MethodNamespace(self)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._deployment, self._method))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._deployment!r})"
+
+
+class _MethodNamespace:
+    def __init__(self, handle: DeploymentHandle):
+        self._handle = handle
+
+    def __getattr__(self, name):
+        return DeploymentHandle(self._handle._deployment, name)

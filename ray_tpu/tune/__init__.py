@@ -193,7 +193,7 @@ class RestoredTuner:
         run_config = RunConfig(name=os.path.basename(self._path),
                                storage_path=os.path.dirname(self._path),
                                checkpoint_config=ckpt_config)
-        controller = TuneController(self._trainable, {}, self._tune_config,
+        controller = TuneController(self._trainable, None, self._tune_config,
                                     run_config)
         trials = []
         for summary in self._state["trials"]:

@@ -87,7 +87,11 @@ class _TrialActor:
         if self._is_class:
             self._instance = trainable(self._config)
             if checkpoint_data is not None:
-                self._instance.load_checkpoint(checkpoint_data)
+                data = dict(checkpoint_data)
+                # iteration travels with the checkpoint so restarts (retry,
+                # PBT exploit, restore) keep training_iteration monotonic
+                self._instance.iteration = data.pop("__tune_iteration__", 0)
+                self._instance.load_checkpoint(data)
         else:
             ctx = TrainContext(experiment_name=experiment_name,
                                trial_id=trial_id)
@@ -108,6 +112,9 @@ class _TrialActor:
                 # populated (reference checkpoints class trainables at
                 # checkpoint_frequency; a per-step dict is cheap here).
                 ckpt = self._instance.save_checkpoint()
+                if ckpt is not None:
+                    ckpt = dict(ckpt)
+                    ckpt["__tune_iteration__"] = self._instance.iteration
             except Exception as e:  # noqa: BLE001
                 import traceback
 

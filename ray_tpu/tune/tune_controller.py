@@ -79,17 +79,22 @@ class TuneController:
         self.scheduler: TrialScheduler = tune_config.scheduler or FIFOScheduler()
         self.exp_dir = run_config.resolved_storage_path()
         os.makedirs(self.exp_dir, exist_ok=True)
-        configs = generate_variants(param_space or {},
-                                    num_samples=tune_config.num_samples,
-                                    seed=tune_config.seed)
-        self.trials: List[Trial] = [
-            Trial(f"trial_{i:05d}", cfg, self.exp_dir)
-            for i, cfg in enumerate(configs)
-        ]
-        for t in self.trials:
-            t.ckpt_manager = CheckpointManager(
-                t.dir, run_config.checkpoint_config)
+        if param_space is None:
+            # restore path: the caller installs a pre-built trial list
+            self.trials: List[Trial] = []
+        else:
+            configs = generate_variants(param_space,
+                                        num_samples=tune_config.num_samples,
+                                        seed=tune_config.seed)
+            self.trials = [
+                Trial(f"trial_{i:05d}", cfg, self.exp_dir)
+                for i, cfg in enumerate(configs)
+            ]
+            for t in self.trials:
+                t.ckpt_manager = CheckpointManager(
+                    t.dir, run_config.checkpoint_config)
         self._inflight: Dict[Any, Trial] = {}  # next_result ref -> trial
+        self._last_state_save = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -164,13 +169,18 @@ class TuneController:
             except Exception:  # noqa: BLE001 (actor/worker death)
                 kind, payload = ERROR, traceback.format_exc()
             self._process_event(trial, kind, payload)
-            self._save_experiment_state()
+            # Throttled: full-state JSON per report is O(trials) disk I/O
+            # in the event loop; terminal transitions always snapshot.
+            if kind != REPORT or \
+                    time.time() - self._last_state_save > 2.0:
+                self._save_experiment_state()
         self._save_experiment_state()
         return self.trials
 
     def _process_event(self, trial: Trial, kind: str, payload):
         if kind == ERROR:
-            if trial.num_restarts < self.rc.failure_config.max_failures:
+            max_failures = self.rc.failure_config.max_failures
+            if max_failures < 0 or trial.num_restarts < max_failures:
                 trial.num_restarts += 1
                 trial.restore_checkpoint = trial.latest_checkpoint_data
                 self._stop_trial(trial, PENDING)
@@ -239,6 +249,7 @@ class TuneController:
         with open(tmp, "w") as f:
             json.dump(state, f, indent=1, default=str)
         os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
+        self._last_state_save = time.time()
 
     def results(self) -> List[Result]:
         out = []

@@ -1,0 +1,272 @@
+"""ray_tpu.serve — deployments, handles, HTTP ingress, autoscaling.
+
+Reference test analogues: `python/ray/serve/tests/test_standalone.py`
+(deploy/call/delete), `test_autoscaling_policy.py` (scale up under load),
+`test_proxy.py` (HTTP routing).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def served(ray_shared):
+    serve.start()
+    yield ray_shared
+    serve.shutdown()
+
+
+def _http(path, body=None, port=None):
+    port = port or serve.http_port()
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method="POST" if data else "GET")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_function_deployment_handle(served):
+    @serve.deployment
+    def echo(req):
+        return {"echo": req}
+
+    h = serve.run(echo.bind(), route_prefix="/echo")
+    out = ray_tpu.get(h.remote({"x": 1}), timeout=30)
+    assert out == {"echo": {"x": 1}}
+    serve.delete("echo")
+
+
+def test_class_deployment_http_and_methods(served):
+    @serve.deployment(name="adder")
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, req):
+            return {"sum": self.base + req["v"]}
+
+        def peek(self, req):
+            return {"base": self.base}
+
+    h = serve.run(Adder.bind(10), route_prefix="/adder")
+    assert ray_tpu.get(h.remote({"v": 5}), timeout=30) == {"sum": 15}
+    # method routing
+    assert ray_tpu.get(h.method.peek.remote(None), timeout=30) == {"base": 10}
+    # HTTP ingress
+    code, out = _http("/adder", {"v": 32})
+    assert code == 200 and out == {"sum": 42}
+    code, routes = _http("/-/routes")
+    assert routes.get("/adder") == "adder"
+    serve.delete("adder")
+
+
+def test_http_404_and_healthz(served):
+    code, _ = _http("/-/healthz")
+    assert code == 200
+    try:
+        code, _ = _http("/nonexistent-route-xyz", {"a": 1})
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code in (404, 500)
+
+
+def test_composition_nested_bind(served):
+    @serve.deployment(name="tokenizer")
+    class Tokenizer:
+        def __call__(self, text):
+            return text.split()
+
+    @serve.deployment(name="pipeline")
+    class Pipeline:
+        def __init__(self, tok_handle):
+            self.tok = tok_handle
+
+        def __call__(self, req):
+            toks = ray_tpu.get(self.tok.remote(req["text"]), timeout=30)
+            return {"n_tokens": len(toks)}
+
+    h = serve.run(Pipeline.bind(Tokenizer.bind()), route_prefix="/pipe")
+    out = ray_tpu.get(h.remote({"text": "a b c d"}), timeout=60)
+    assert out == {"n_tokens": 4}
+    serve.delete("pipeline")
+    serve.delete("tokenizer")
+
+
+def test_multiple_replicas_share_load(served):
+    @serve.deployment(name="slowid", num_replicas=2)
+    class SlowId:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, req):
+            time.sleep(0.3)
+            return self.pid
+
+    h = serve.run(SlowId.bind(), route_prefix="/slowid")
+    t0 = time.perf_counter()
+    refs = [h.remote(None) for _ in range(4)]
+    pids = set(ray_tpu.get(refs, timeout=60))
+    dt = time.perf_counter() - t0
+    assert len(pids) == 2, "requests did not spread over both replicas"
+    assert dt < 4 * 0.3, f"replicas did not serve concurrently: {dt:.2f}s"
+    serve.delete("slowid")
+
+
+def test_autoscaling_up_and_down(served):
+    @serve.deployment(
+        name="burst", num_replicas=1,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+            upscale_delay_s=0.0, downscale_delay_s=1.0,
+            smoothing_factor=1.0))
+    class Burst:
+        def __call__(self, req):
+            time.sleep(0.4)
+            return "done"
+
+    serve.run(Burst.bind(), route_prefix="/burst")
+    assert serve.status()["burst"]["running"] == 1
+
+    stop = threading.Event()
+
+    def flood():
+        h = serve.get_deployment_handle("burst")
+        while not stop.is_set():
+            try:
+                refs = [h.remote(None) for _ in range(4)]
+                ray_tpu.get(refs, timeout=30)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=flood, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 30
+        scaled_up = False
+        while time.time() < deadline:
+            if serve.status()["burst"]["running"] >= 2:
+                scaled_up = True
+                break
+            time.sleep(0.3)
+        assert scaled_up, f"never scaled up: {serve.status()}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    # idle -> back toward min after downscale_delay
+    deadline = time.time() + 30
+    scaled_down = False
+    while time.time() < deadline:
+        if serve.status()["burst"]["target"] == 1:
+            scaled_down = True
+            break
+        time.sleep(0.3)
+    assert scaled_down, f"never scaled down: {serve.status()}"
+    serve.delete("burst")
+
+
+def test_redeploy_in_place(served):
+    @serve.deployment(name="ver")
+    def v1(req):
+        return 1
+
+    @serve.deployment(name="ver")
+    def v2(req):
+        return 2
+
+    h = serve.run(v1.bind(), route_prefix="/ver")
+    assert ray_tpu.get(h.remote(None), timeout=30) == 1
+    h = serve.run(v2.bind(), route_prefix="/ver")
+    assert ray_tpu.get(h.remote(None), timeout=30) == 2
+    serve.delete("ver")
+
+
+def test_llama_generate_deployment(served):
+    """The serving flagship: tiny-llama generate behind serve
+    (BASELINE.json 'Ray Serve Llama-2-7B JAX inference deployment' shape,
+    tiny config on CPU)."""
+
+    @serve.deployment(name="llama")
+    class LlamaServer:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.models import llama
+
+            self.cfg = llama.LLAMA_TINY
+            self.params = llama.init_params(jax.random.PRNGKey(0), self.cfg)
+            self.llama = llama
+
+        def __call__(self, req):
+            import jax.numpy as jnp
+
+            prompt = jnp.asarray(req["prompt_tokens"], jnp.int32)[None]
+            toks = self.llama.generate(
+                self.params, prompt, self.cfg,
+                max_new_tokens=int(req.get("max_new_tokens", 4)),
+                temperature=0.0)
+            return {"tokens": [int(t) for t in toks[0]]}
+
+    h = serve.run(LlamaServer.bind(), route_prefix="/llama")
+    code, out = _http("/llama", {"prompt_tokens": [1, 2, 3],
+                                 "max_new_tokens": 4})
+    assert code == 200
+    assert len(out["tokens"]) >= 4
+    serve.delete("llama")
+
+
+def test_failing_constructor_surfaces_error(served):
+    @serve.deployment(name="broken")
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("boom at init")
+
+        def __call__(self, req):
+            return "unreachable"
+
+    with pytest.raises((RuntimeError, TimeoutError)):
+        serve.run(Broken.bind(), route_prefix="/broken", timeout=30)
+    st = serve.status().get("broken", {})
+    assert st.get("unhealthy"), f"deployment not marked unhealthy: {st}"
+    serve.delete("broken")
+
+
+def test_dead_replica_is_replaced(served):
+    @serve.deployment(name="fragile")
+    class Fragile:
+        def __call__(self, req):
+            if req == "die":
+                import os
+
+                os._exit(1)
+            return "alive"
+
+    h = serve.run(Fragile.bind(), route_prefix="/fragile")
+    assert ray_tpu.get(h.remote("ok"), timeout=30) == "alive"
+    try:
+        ray_tpu.get(h.remote("die"), timeout=30)
+    except Exception:
+        pass
+    # controller must detect the death and respawn a replacement
+    deadline = time.time() + 30
+    recovered = False
+    while time.time() < deadline:
+        try:
+            if ray_tpu.get(h.remote("ok"), timeout=10) == "alive":
+                recovered = True
+                break
+        except Exception:
+            time.sleep(0.3)
+    assert recovered, f"replica never replaced: {serve.status()}"
+    serve.delete("fragile")

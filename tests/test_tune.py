@@ -239,3 +239,41 @@ def test_class_trainable_checkpoints_collected(ray, tmp_path):
     r = grid[0]
     assert r.checkpoint is not None
     assert r.checkpoint.to_dict()["total"] == 3
+
+
+def test_class_trainable_iteration_survives_restart(ray, tmp_path):
+    """training_iteration must continue across a failure restart (it
+    travels with the checkpoint)."""
+
+    class Flaky(tune.Trainable):
+        def setup(self, config):
+            self.total = 0
+            self.restored = False
+
+        def step(self):
+            self.total += 1
+            if self.total == 3 and not self.restored:
+                raise RuntimeError("transient failure at step 3")
+            return {"score": self.total}
+
+        def save_checkpoint(self):
+            return {"total": self.total}
+
+        def load_checkpoint(self, data):
+            self.total = data["total"]
+            self.restored = True
+
+    from ray_tpu.air.config import FailureConfig
+
+    grid = tune.Tuner(
+        Flaky, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    stop={"training_iteration": 5}),
+        run_config=tune.RunConfig(
+            name="flaky", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    r = grid[0]
+    assert r.error is None, f"trial errored: {r.error}"
+    assert r.metrics["training_iteration"] == 5
+    assert r.metrics["score"] == 5
