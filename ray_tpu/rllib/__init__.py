@@ -1,0 +1,32 @@
+"""ray_tpu.rllib — RL training: EnvRunner actors + jitted learners.
+
+Reference analogue: the `rllib/` tree (Algorithm/RolloutWorker/
+SampleBatch/Learner).  Scope here is the new-stack core: ``Algorithm``
+(a Tune Trainable driving EnvRunner actors, `algorithm.py`), ``PPO``
+(`ppo.py` — GAE + clipped surrogate, the whole update one jitted XLA
+program), ``SampleBatch`` (`sample_batch.py`), pure-JAX policy models
+(`models.py`).
+
+Usage:
+    config = (PPOConfig()
+              .environment(lambda: gymnasium.make("CartPole-v1"))
+              .env_runners(num_env_runners=4))
+    algo = config.build()
+    while algo.train()["episode_reward_mean"] < 450: pass
+
+``PPO`` is a ``tune.Trainable`` — pass it (or a config dict) straight to
+``tune.Tuner`` for PBT-over-PPO (the reference's flagship Tune+RLlib
+combo).
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.models import init_mlp_policy, mlp_forward, sample_action
+from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "EnvRunner", "PPO", "PPOConfig",
+    "SampleBatch", "compute_gae", "init_mlp_policy", "mlp_forward",
+    "sample_action",
+]

@@ -1,0 +1,133 @@
+"""EnvRunner — an actor that rolls out a policy in vectorized CPU envs.
+
+Reference analogues: `rllib/evaluation/rollout_worker.py:660`
+(``RolloutWorker.sample`` — the env-step hot loop),
+`rllib/env/env_runner.py:9` (the EnvRunner base).
+
+The runner owns B gymnasium envs (SyncVectorEnv) and the current policy
+weights; ``sample()`` steps T*B transitions with a jitted forward and
+returns a SampleBatch (numpy — travels the object plane to the learner).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    VALUES,
+    SampleBatch,
+)
+
+
+class EnvRunner:
+    def __init__(self, env_creator, num_envs: int, rollout_length: int,
+                 policy_init, seed: int = 0):
+        """env_creator() -> gymnasium.Env; policy_init(rng, obs_dim,
+        num_actions) -> params (only used for shape checks on the runner —
+        weights always come from the learner via set_weights)."""
+        import gymnasium as gym
+        import jax
+
+        from ray_tpu.rllib.models import sample_action
+
+        # SAME_STEP autoreset (classic semantics): a terminated env returns
+        # the reset obs in the same step() call.  gymnasium >= 1.0 defaults
+        # to NEXT_STEP, where the step after termination IGNORES the action
+        # and yields reward 0 — recording that as a transition injects
+        # garbage gradients (~1/ep_len of the batch).
+        try:
+            self._envs = gym.vector.SyncVectorEnv(
+                [env_creator for _ in range(num_envs)],
+                autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        except TypeError:  # older gymnasium: SAME_STEP was the default
+            self._envs = gym.vector.SyncVectorEnv(
+                [env_creator for _ in range(num_envs)])
+        self._num_envs = num_envs
+        self._T = rollout_length
+        self._params = None
+        self._key = jax.random.PRNGKey(seed)
+        self._sample_action = jax.jit(sample_action)
+        obs, _ = self._envs.reset(seed=seed)
+        self._obs = np.asarray(obs, np.float32)
+        # per-env running episode returns (for episode_reward metrics)
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: list = []
+
+    def set_weights(self, params):
+        self._params = params
+        return True
+
+    def sample(self) -> Dict[str, Any]:
+        """Roll out T steps in all envs; returns {'batch': SampleBatch,
+        'metrics': {...}} — the batch carries VALUES and NEXT_OBS so the
+        learner can bootstrap GAE."""
+        import jax
+
+        assert self._params is not None, "set_weights before sample"
+        T, B = self._T, self._num_envs
+        obs_buf = np.empty((T, B) + self._obs.shape[1:], np.float32)
+        act_buf = np.empty((T, B), np.int64)
+        logp_buf = np.empty((T, B), np.float32)
+        val_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        done_buf = np.empty((T, B), np.float32)
+
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._sample_action(
+                self._params, self._obs, sub)
+            action = np.asarray(action)
+            next_obs, reward, terminated, truncated, _ = self._envs.step(
+                action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            rew_buf[t] = reward
+            # GAE cuts only at TERMINATION; truncation (time limit) still
+            # bootstraps — but SyncVectorEnv auto-resets, so the stored
+            # next_obs after either is the reset obs and we conservatively
+            # cut on both (standard for CartPole-scale tasks).
+            done = np.logical_or(terminated, truncated)
+            done_buf[t] = done.astype(np.float32)
+            self._ep_return += reward
+            self._ep_len += 1
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._ep_return[i]), int(self._ep_len[i])))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = np.asarray(next_obs, np.float32)
+
+        # bootstrap value for the final observation of each env
+        self._key, sub = jax.random.split(self._key)
+        _, _, last_value = self._sample_action(self._params, self._obs, sub)
+
+        batch = SampleBatch({
+            OBS: obs_buf.reshape(T * B, -1),
+            ACTIONS: act_buf.reshape(T * B),
+            LOGPS: logp_buf.reshape(T * B),
+            VALUES: val_buf.reshape(T * B),
+            REWARDS: rew_buf.reshape(T * B),
+            DONES: done_buf.reshape(T * B),
+        })
+        completed, self._completed = self._completed, []
+        return {
+            "batch": batch,
+            # time-major shape + bootstrap values for learner-side GAE
+            "t_shape": (T, B),
+            "last_values": np.asarray(last_value, np.float32),
+            "metrics": {
+                "episodes": completed,
+                "env_steps": T * B,
+            },
+        }

@@ -1,0 +1,61 @@
+"""Policy/value networks — pure-JAX MLPs.
+
+Reference analogue: `rllib/models/catalog.py` + `rllib/core/rl_module/`
+(the RLModule forward).  TPU-first: a functional init/apply pair the
+learner jits end-to-end; no framework wrapper classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp_policy(rng, obs_dim: int, num_actions: int,
+                    hidden: Sequence[int] = (64, 64)) -> Dict[str, Any]:
+    """Shared torso, categorical policy head + value head."""
+    params = {}
+    sizes = [obs_dim, *hidden]
+    keys = jax.random.split(rng, len(hidden) + 2)
+    for i in range(len(hidden)):
+        k1, _ = jax.random.split(keys[i])
+        scale = jnp.sqrt(2.0 / sizes[i])
+        params[f"fc_{i}"] = {
+            "w": jax.random.normal(k1, (sizes[i], sizes[i + 1]),
+                                   jnp.float32) * scale,
+            "b": jnp.zeros((sizes[i + 1],)),
+        }
+    params["pi"] = {
+        "w": jax.random.normal(keys[-2], (sizes[-1], num_actions),
+                               jnp.float32) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[-1], (sizes[-1], 1), jnp.float32) * 1.0,
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def mlp_forward(params, obs):
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    x = obs
+    i = 0
+    while f"fc_{i}" in params:
+        p = params[f"fc_{i}"]
+        x = jnp.tanh(x @ p["w"] + p["b"])
+        i += 1
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def sample_action(params, obs, key):
+    """Returns (action, logp, value) for a batch of observations."""
+    logits, value = mlp_forward(params, obs)
+    action = jax.random.categorical(key, logits, axis=-1)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(action.shape[0]), action]
+    return action, logp, value

@@ -1,0 +1,195 @@
+"""PPO — clipped-surrogate policy optimization with GAE.
+
+Reference analogues: `rllib/algorithms/ppo/ppo.py:420` (``training_step``:
+sample -> train -> sync weights), `rllib/core/learner/learner.py:229`
+(gradient computation/update), `rllib/evaluation/postprocessing.py`
+(``compute_gae_for_sample_batch``).
+
+TPU-first: the whole update (losses, grads, adamw, minibatch epochs) jits
+to one XLA program via ``lax.scan`` over shuffled minibatches — the
+learner runs on whatever device jax puts it on (TPU for Atari-scale,
+CPU in tests); env stepping stays on CPU runner actors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    TARGETS,
+    VALUES,
+    SampleBatch,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.gae_lambda = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 4
+        self.minibatch_size = 512
+        self.grad_clip = 0.5
+        self.hidden = (64, 64)
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def compute_gae(rewards, values, dones, last_values, gamma, lam):
+    """Time-major (T, B) numpy GAE (reference:
+    `rllib/evaluation/postprocessing.py` ``compute_advantages``)."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    gae = np.zeros_like(last_values)
+    next_value = last_values
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        gae = delta + gamma * lam * nonterminal * gae
+        adv[t] = gae
+        next_value = values[t]
+    targets = adv + values
+    return adv, targets
+
+
+def _make_update_fn(cfg: PPOConfig, optimizer):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import mlp_forward
+
+    def loss_fn(params, mb):
+        logits, value = mlp_forward(params, mb[OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, mb[ACTIONS][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - mb[LOGPS])
+        adv = mb[ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv)
+        policy_loss = -surr.mean()
+        # clipped value loss (reference PPO `vf_clip_param`)
+        vf_err = jnp.square(value - mb[TARGETS])
+        vf_clipped = mb[VALUES] + jnp.clip(
+            value - mb[VALUES], -cfg.vf_clip_param, cfg.vf_clip_param)
+        vf_err2 = jnp.square(vf_clipped - mb[TARGETS])
+        vf_loss = 0.5 * jnp.maximum(vf_err, vf_err2).mean()
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        kl = (mb[LOGPS] - logp).mean()
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "kl": kl}
+
+    def minibatch_step(carry, mb):
+        params, opt_state = carry
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, mb)
+        if cfg.grad_clip:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            scale = jnp.minimum(1.0, cfg.grad_clip / (gnorm + 1e-8))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return (params, opt_state), metrics
+
+    @jax.jit
+    def update(params, opt_state, batch, rng):
+        """num_epochs x shuffled-minibatch SGD as ONE compiled program:
+        lax.scan over a (epochs*num_mb, mb_size) gather of the batch."""
+        n = batch[OBS].shape[0]
+        num_mb = max(n // cfg.minibatch_size, 1)
+        mb_size = n // num_mb
+
+        def epoch_perm(key):
+            return jax.random.permutation(key, n)[:num_mb * mb_size]
+
+        keys = jax.random.split(rng, cfg.num_epochs)
+        idx = jnp.concatenate([epoch_perm(k) for k in keys])
+        idx = idx.reshape(cfg.num_epochs * num_mb, mb_size)
+        mbs = {k: v[idx] for k, v in batch.items()}  # (steps, mb, ...)
+        (params, opt_state), metrics = jax.lax.scan(
+            minibatch_step, (params, opt_state), mbs)
+        return params, opt_state, jax.tree.map(lambda m: m[-1], metrics)
+
+    return update
+
+
+class PPO(Algorithm):
+    _config_cls = PPOConfig
+
+    def build_learner(self):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.models import init_mlp_policy
+
+        cfg: PPOConfig = self.algo_config
+        probe_env = cfg.env_creator()
+        obs_dim = int(np.prod(probe_env.observation_space.shape))
+        num_actions = int(probe_env.action_space.n)
+        probe_env.close()
+        self._params = init_mlp_policy(
+            jax.random.PRNGKey(cfg.seed), obs_dim, num_actions, cfg.hidden)
+        self._optimizer = optax.adam(cfg.lr)
+        self._opt_state = self._optimizer.init(self._params)
+        self._update = _make_update_fn(cfg, self._optimizer)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+    def set_weights(self, weights):
+        self._params = weights
+
+    def training_step(self) -> Dict[str, Any]:
+        """sample -> GAE -> jitted minibatch-epoch update -> broadcast
+        (reference `ppo.py:420`)."""
+        import jax
+
+        cfg: PPOConfig = self.algo_config
+        rollouts = self.synchronous_parallel_sample()
+        batches: List[SampleBatch] = []
+        for ro in rollouts:
+            b = ro["batch"]
+            T, B = ro["t_shape"]
+            adv, targets = compute_gae(
+                b[REWARDS].reshape(T, B), b[VALUES].reshape(T, B),
+                b[DONES].reshape(T, B), ro["last_values"],
+                cfg.gamma, cfg.gae_lambda)
+            b[ADVANTAGES] = adv.reshape(T * B).astype(np.float32)
+            b[TARGETS] = targets.reshape(T * B).astype(np.float32)
+            batches.append(b)
+        train_batch = SampleBatch.concat(batches)
+        learn_batch = {
+            OBS: train_batch[OBS], ACTIONS: train_batch[ACTIONS],
+            LOGPS: train_batch[LOGPS], VALUES: train_batch[VALUES],
+            ADVANTAGES: train_batch[ADVANTAGES],
+            TARGETS: train_batch[TARGETS],
+        }
+        self._rng, sub = jax.random.split(self._rng)
+        self._params, self._opt_state, metrics = self._update(
+            self._params, self._opt_state, learn_batch, sub)
+        self.sync_weights()
+        out = {k: float(v) for k, v in metrics.items()}
+        out["_steps_this_iter"] = train_batch.count
+        return out
