@@ -65,7 +65,12 @@ def init(
     configure_logging: bool = True,
     **kwargs,
 ):
-    """Start the runtime (reference: `python/ray/_private/worker.py:1106`)."""
+    """Start the runtime (reference: `python/ray/_private/worker.py:1106`).
+
+    ``address``: GCS address ``"host:port"`` to join an existing cluster as
+    a driver (reference ``ray.init(address=...)``); None starts the embedded
+    single-node runtime.
+    """
     if is_initialized():
         if ignore_reinit_error:
             return
@@ -73,6 +78,11 @@ def init(
                            "(pass ignore_reinit_error=True to allow)")
     if local_mode:
         init_worker(LocalWorker())
+        return
+    if address is not None:
+        from ray_tpu.core.client import ClientWorker
+
+        init_worker(ClientWorker(address))
         return
     init_worker(
         DriverWorker(
@@ -155,36 +165,9 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort cancel of a pending task (running tasks finish; force-kill
-    of running normal tasks lands with multi-node)."""
+    """Best-effort cancel of a pending task (running tasks finish)."""
     w = global_worker()
-    if w.mode != "driver":
-        raise NotImplementedError("cancel() from inside tasks")
-
-    def _cancel():
-        raylet = w.raylet
-        tid = ref.id().task_id()
-        entry = raylet._waiting.pop(tid, None)
-        found = entry is not None
-        if entry is not None:
-            spec, missing = entry
-            for oid in missing:
-                s = raylet._dep_index.get(oid)
-                if s:
-                    s.discard(tid)
-        for q in (raylet._ready_queue,):
-            for spec in list(q):
-                if spec.task_id == tid:
-                    q.remove(spec)
-                    found = True
-        if found:
-            from ray_tpu.core.exceptions import TaskError as _TE
-
-            err = _TE("cancelled", "task was cancelled before it ran", None)
-            raylet._object_error(ref.id(), err)
-        return found
-
-    w.raylet.call(_cancel).result()
+    return w.cancel(ref)
 
 
 def free(refs: Sequence[ObjectRef]):
@@ -192,29 +175,39 @@ def free(refs: Sequence[ObjectRef]):
 
 
 def cluster_resources() -> dict:
+    """Aggregate TOTAL resources across alive nodes."""
     w = global_worker()
-    if w.mode == "driver":
-        return dict(w.raylet.resources_total)
-    return {}
+    if w.mode == "local":
+        return {}
+    total: dict = {}
+    for n in w.gcs_nodes():
+        if n.get("alive"):
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
 
 
 def available_resources() -> dict:
     w = global_worker()
     if w.mode == "driver":
         return w.raylet.call(lambda: dict(w.raylet.resources_available)).result()
+    if w.mode == "client":
+        return w._request("available_resources")
     return {}
 
 
 def nodes() -> List[dict]:
+    """Cluster membership (reference: ``ray.nodes()``)."""
     w = global_worker()
-    if w.mode == "driver":
-        snap = w.raylet.call(w.raylet.state_snapshot).result()
-        return [{
-            "NodeID": snap["node_id"],
-            "Alive": True,
-            "Resources": snap["resources_total"],
-        }]
-    return []
+    if w.mode == "local":
+        return []
+    return [{
+        "NodeID": n["node_id"],
+        "Alive": n.get("alive", True),
+        "Resources": n.get("resources_total", {}),
+        "Address": n.get("address"),
+        "Hostname": n.get("hostname", ""),
+    } for n in w.gcs_nodes()]
 
 
 def timeline(filename: Optional[str] = None):
@@ -223,7 +216,10 @@ def timeline(filename: Optional[str] = None):
     import json
 
     w = global_worker()
-    snap = w.raylet.call(w.raylet.state_snapshot).result()
+    if w.mode == "client":
+        snap = w._request("state_snapshot")
+    else:
+        snap = w.raylet.call(w.raylet.state_snapshot).result()
     events = []
     starts = {}
     for ev in snap["events"]:

@@ -1,0 +1,172 @@
+"""Fake multi-node cluster for tests — the ``ray.cluster_utils.Cluster``
+analogue (`python/ray/cluster_utils.py:99`, ``add_node`` `:165`).
+
+Spawns a real GCS server process and one raylet PROCESS per simulated node
+on this machine, each with its own shm object store, worker pool, and TCP
+listener — so scheduling spillback, cross-node object transfer, and node
+failure (``remove_node`` kills the raylet with SIGKILL) exercise the same
+code paths a physical cluster would.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class NodeHandle:
+    def __init__(self, proc: subprocess.Popen, node_id: str, port: int,
+                 resources: Dict[str, float]):
+        self.proc = proc
+        self.node_id = node_id
+        self.port = port
+        self.resources = resources
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float = 30.0):
+    """Read stdout lines until one starts with ``tag`` (startup banner)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"process exited with {proc.returncode} before printing "
+                    f"{tag!r}: {proc.stderr.read() if proc.stderr else ''}")
+            time.sleep(0.01)
+            continue
+        line = line.strip()
+        if line.startswith(tag):
+            return line
+    raise TimeoutError(f"timed out waiting for {tag!r} banner")
+
+
+class Cluster:
+    """Start with a head node, then ``add_node`` more; ``connect`` attaches
+    the current process as a driver (``ray_tpu.init(address=...)``)."""
+
+    def __init__(self, initialize_head: bool = True,
+                 head_resources: Optional[Dict[str, float]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self._env = dict(os.environ)
+        # Subprocesses must resolve ray_tpu (and the user's modules) no
+        # matter their cwd — propagate the driver's import path, the same
+        # way the raylet ships it to workers.
+        path_entries = [p for p in sys.path if p] + [
+            p for p in self._env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        seen: set = set()
+        self._env["PYTHONPATH"] = os.pathsep.join(
+            p for p in path_entries if not (p in seen or seen.add(p)))
+        # Fast failure detection for tests (prod tunes these up).
+        self._env.setdefault("RAY_TPU_GCS_HEARTBEAT_INTERVAL_S", "0.1")
+        self._env.setdefault("RAY_TPU_GCS_NODE_TIMEOUT_S", "1.5")
+        # Cluster workers are control-plane only in tests: never let them
+        # grab the TPU chip or spend seconds importing jax eagerly.
+        self._env.setdefault("JAX_PLATFORMS", "cpu")
+        self._env.update(env or {})
+        self.nodes: List[NodeHandle] = []
+        self._gcs_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.gcs_main"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=self._env)
+        banner = _read_tagged_line(self._gcs_proc, "GCS_ADDRESS")
+        self.address = banner.split()[1]
+        self._connected = False
+        if initialize_head:
+            self.head_node = self.add_node(
+                **(head_resources or {"num_cpus": 2}))
+
+    def add_node(self, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_mb: int = 128) -> NodeHandle:
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        import json
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.raylet_main",
+             "--gcs", self.address,
+             "--resources", json.dumps(res),
+             "--store-mb", str(object_store_mb)],
+            stdout=subprocess.PIPE, stderr=None,
+            text=True, env=self._env)
+        banner = _read_tagged_line(proc, "RAYLET")
+        fields = dict(kv.split("=") for kv in banner.split()[1:])
+        handle = NodeHandle(proc, fields["node_id"], int(fields["port"]), res)
+        self.nodes.append(handle)
+        return handle
+
+    def remove_node(self, node: NodeHandle, allow_graceful: bool = False):
+        """SIGKILL by default — simulates node failure (reference:
+        ``Cluster.remove_node`` / NodeKillerActor chaos tooling)."""
+        if node.alive():
+            node.proc.send_signal(
+                signal.SIGTERM if allow_graceful else signal.SIGKILL)
+            node.proc.wait(timeout=10)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def connect(self):
+        import ray_tpu
+
+        ray_tpu.init(address=self.address)
+        self._connected = True
+        return self
+
+    def wait_for_nodes(self, count: Optional[int] = None, timeout: float = 10):
+        """Block until GCS sees ``count`` (default: all started) alive nodes."""
+        from ray_tpu.core.gcs import GcsClient
+
+        want = count if count is not None else len(self.nodes)
+        cli = GcsClient(self.address)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                alive = [n for n in cli.nodes() if n["alive"]]
+                if len(alive) >= want:
+                    return True
+                time.sleep(0.05)
+            raise TimeoutError(
+                f"only {len(alive)} of {want} nodes registered")
+        finally:
+            cli.close()
+
+    def shutdown(self):
+        import ray_tpu
+
+        if self._connected:
+            try:
+                ray_tpu.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._connected = False
+        for node in list(self.nodes):
+            try:
+                self.remove_node(node, allow_graceful=True)
+            except Exception:  # noqa: BLE001
+                try:
+                    node.proc.kill()
+                except OSError:
+                    pass
+        if self._gcs_proc.poll() is None:
+            self._gcs_proc.terminate()
+            try:
+                self._gcs_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._gcs_proc.kill()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

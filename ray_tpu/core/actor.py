@@ -147,14 +147,21 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
     worker = global_worker()
     if worker.mode == "driver":
         raylet = worker.raylet
+        # Through the event loop: an actor created just before via the
+        # async submit path is guaranteed registered once this runs.
+        info = raylet.call(
+            lambda: raylet.gcs.lookup_named_actor(namespace, name)).result()
+        if info is None:
+            raise ValueError(f"no actor named {name!r}")
+        aid = ActorID(info["actor_id"])
+        if info.get("spec_blob"):
+            import cloudpickle as _cp
 
-        def lookup():
-            aid = raylet._named_actors.get((namespace, name))
-            if aid is None:
-                raise ValueError(f"no actor named {name!r}")
-            return aid, raylet._actors[aid].creation_spec
-
-        aid, creation_spec = raylet.call(lookup).result()
+            creation_spec = _cp.loads(info["spec_blob"])
+        else:
+            raylet = worker.raylet
+            creation_spec = raylet.call(
+                lambda: raylet._actors[aid].creation_spec).result()
     else:
         info = worker._request("named_actor", name=name, namespace=namespace)
         aid, creation_spec = info["actor_id"], info["creation_spec"]

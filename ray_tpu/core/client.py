@@ -1,0 +1,141 @@
+"""ClientWorker — a driver connected to a cluster over TCP.
+
+Reference analogue: a driver's ``CoreWorker`` connecting to its local raylet
+(`python/ray/_private/worker.py:2020` → `ConnectToRaylet`,
+`src/ray/core_worker/core_worker.h:313`) — here the driver speaks the same
+framed request protocol the workers use, to the raylet's TCP listener, and
+holds a ``GcsClient`` for cluster-level queries.  When the raylet is on the
+same host the driver attaches its shm store for zero-copy gets; otherwise
+large objects would need a socket fetch (not yet wired — same-host only).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.core import protocol
+from ray_tpu.core.gcs import GcsClient
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.worker import Worker
+
+
+class ClientWorker(Worker):
+    """Driver-side connection to a raylet over TCP ("client" mode)."""
+
+    def __init__(self, gcs_address: str, node_id: Optional[str] = None):
+        super().__init__("client")
+        self.gcs = GcsClient(gcs_address)
+        nodes = [n for n in self.gcs.nodes() if n["alive"] and n["address"]]
+        if not nodes:
+            raise ConnectionError(f"no alive nodes registered at {gcs_address}")
+        if node_id is not None:
+            nodes = [n for n in nodes if n["node_id"] == node_id]
+            if not nodes:
+                raise ValueError(f"node {node_id} not found/alive")
+        # prefer a raylet on this host (store attach works there)
+        hostname = socket.gethostname()
+        nodes.sort(key=lambda n: (n.get("hostname") != hostname,))
+        info = nodes[0]
+        host, port = info["address"]
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.send_lock = threading.Lock()
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+        self._pending: Dict[int, dict] = {}
+        self._hello = threading.Event()
+        self._hello_msg: Optional[dict] = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="client-reader", daemon=True)
+        self._reader.start()
+        self._send({"t": "driver_hello"})
+        if not self._hello.wait(10):
+            raise ConnectionError("raylet handshake timed out")
+        self.node_id = self._hello_msg["node_id"]
+        self.session_dir = self._hello_msg["session_dir"]
+        store_path = self._hello_msg.get("store_path")
+        if store_path:
+            try:
+                self.store = ShmObjectStore(store_path)
+            except OSError:
+                self.store = None  # different host: no shm access
+
+    # Worker.get/put/wait/submit use _send/_request like worker mode does.
+
+    def _read_loop(self):
+        while True:
+            try:
+                msg = protocol.recv_msg(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                err = ConnectionError("raylet connection lost")
+                for entry in list(self._pending.values()):
+                    entry["msg"] = {"ok": False, "error": err}
+                    entry["event"].set()
+                return
+            t = msg.get("t")
+            if t == "hello_reply":
+                self._hello_msg = msg
+                self._hello.set()
+            elif t == "reply":
+                entry = self._pending.pop(msg["rid"], None)
+                if entry is not None:
+                    entry["msg"] = msg
+                    entry["event"].set()
+
+    def _send(self, msg):
+        protocol.send_msg(self.sock, msg, self.send_lock)
+
+    def _request(self, op, _wait_timeout=None, **fields):
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        entry = {"event": threading.Event(), "msg": None}
+        self._pending[rid] = entry
+        self._send({"t": "request", "rid": rid, "op": op, **fields})
+        if not entry["event"].wait(_wait_timeout):
+            self._pending.pop(rid, None)
+            self._send({"t": "request", "rid": rid + (1 << 62),
+                        "op": "cancel_request", "target_rid": rid})
+            raise TimeoutError(f"request {op} timed out")
+        msg = entry["msg"]
+        if not msg["ok"]:
+            raise msg["error"]
+        return msg["value"]
+
+    def gcs_nodes(self):
+        return self.gcs.nodes()
+
+    def kv_put(self, key: bytes, value: bytes, namespace: str = ""):
+        self.gcs.kv_put(namespace, key, value)
+
+    def kv_get(self, key: bytes, namespace: str = ""):
+        return self.gcs.kv_get(namespace, key)
+
+    def kv_del(self, key: bytes, namespace: str = ""):
+        return self.gcs.kv_del(namespace, key)
+
+    def kv_keys(self, prefix: bytes, namespace: str = ""):
+        return self.gcs.kv_keys(namespace, prefix)
+
+    def _push_function(self, fid, blob: bytes):
+        self.gcs.put_function(fid.binary(), blob)
+
+    def shutdown(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        try:
+            self.gcs.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self.store is not None:
+            try:
+                self.store.close()
+            except Exception:  # noqa: BLE001
+                pass

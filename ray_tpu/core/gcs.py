@@ -1,0 +1,556 @@
+"""GCS — the cluster control plane (head service).
+
+Reference analogue: the GCS server (`src/ray/gcs/gcs_server/gcs_server.h:78`)
+with its node / actor / KV / function / object-directory tables
+(`gcs_node_manager`, `gcs_actor_manager.cc`, `gcs_kv_manager`), the GCS
+client accessors (`src/ray/gcs/gcs_client/accessor.h:40`), and the
+health-check manager (`gcs_health_check_manager.h`).
+
+Re-designed for this runtime: one ``GcsCore`` object owns every table behind
+a single lock (the tables are dict operations — there is nothing to gain
+from an event loop), with three access paths:
+
+  * embedded  — the single-node default: the driver's in-process raylet holds
+    a direct reference to ``GcsCore`` (zero-cost control plane);
+  * ``GcsServer`` — a TCP server exposing the same surface over the framed
+    pickle protocol (`ray_tpu/core/protocol.py`), one thread per connection
+    (node counts are small; the data plane never flows through the GCS);
+  * ``GcsClient`` — socket client with an identical duck-typed method
+    surface, so the raylet code does not know which one it holds.
+
+Pushes (reference: `src/ray/pubsub/`): subscribers receive node membership
+events and object-directory watch notifications. The object directory is
+location metadata only — object bytes move raylet-to-raylet (see
+`raylet.py` pull protocol), matching the reference's split between the GCS
+and the object manager (`src/ray/object_manager/object_manager.h:117`).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core import protocol
+from ray_tpu.core.config import config
+
+config.define("gcs_heartbeat_interval_s", float, 0.25,
+              "Raylet -> GCS resource heartbeat period.")
+config.define("gcs_node_timeout_s", float, 3.0,
+              "Heartbeat silence after which a node is declared dead "
+              "(reference: health check manager timeouts).")
+
+
+class GcsCore:
+    """All control-plane tables. Thread-safe; no I/O of its own."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # node_id(hex) -> {address:(host,port)|None, resources_total,
+        #                  resources_available, store_path, alive,
+        #                  last_heartbeat, hostname}
+        self._nodes: Dict[str, dict] = {}
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}
+        self._functions: Dict[bytes, bytes] = {}
+        # actor_id(bytes) -> {owner_node, state, name, namespace, spec_blob}
+        self._actors: Dict[bytes, dict] = {}
+        self._named: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
+        # oid(hex) -> {nodes: set[node_id], size, inline}
+        self._objects: Dict[str, dict] = {}
+        # oid(hex) -> set of watcher node_ids (want a push when located)
+        self._object_watchers: Dict[str, set] = {}
+        # subscribers: (node_id_or_None, callback(event, data))
+        self._subs: List[Tuple[Optional[str], Callable[[str, Any], None]]] = []
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ----------------------------------------------------------- pubsub
+
+    def subscribe(self, callback: Callable[[str, Any], None],
+                  node_id: Optional[str] = None):
+        with self._lock:
+            self._subs.append((node_id, callback))
+
+    def unsubscribe(self, callback):
+        with self._lock:
+            self._subs = [(n, cb) for n, cb in self._subs if cb is not callback]
+
+    def _publish(self, event: str, data: Any,
+                 target_node: Optional[str] = None):
+        with self._lock:
+            subs = list(self._subs)
+        for node_id, cb in subs:
+            if target_node is not None and node_id != target_node:
+                continue
+            try:
+                cb(event, data)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    # ----------------------------------------------------------- nodes
+
+    def register_node(self, node_id: str, address: Optional[tuple],
+                      resources: Dict[str, float],
+                      store_path: Optional[str] = None,
+                      hostname: str = "") -> List[dict]:
+        with self._lock:
+            self._nodes[node_id] = {
+                "node_id": node_id,
+                "address": address,
+                "resources_total": dict(resources),
+                "resources_available": dict(resources),
+                "store_path": store_path,
+                "hostname": hostname,
+                "alive": True,
+                "last_heartbeat": time.monotonic(),
+            }
+            snapshot = [dict(n) for n in self._nodes.values()]
+        self._publish("node_added", {"node_id": node_id, "address": address})
+        return snapshot
+
+    def unregister_node(self, node_id: str):
+        self._mark_dead(node_id, "node drained")
+
+    def heartbeat(self, node_id: str, resources_available: Dict[str, float],
+                  queue_len: int = 0) -> bool:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info["alive"]:
+                return False
+            info["resources_available"] = dict(resources_available)
+            info["queue_len"] = queue_len
+            info["last_heartbeat"] = time.monotonic()
+            return True
+
+    def nodes(self) -> List[dict]:
+        with self._lock:
+            return [dict(n) for n in self._nodes.values()]
+
+    def get_node(self, node_id: str) -> Optional[dict]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            return dict(info) if info else None
+
+    def _mark_dead(self, node_id: str, reason: str):
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info["alive"]:
+                return
+            info["alive"] = False
+            # prune the directory: bytes on a dead node are gone
+            for entry in self._objects.values():
+                entry["nodes"].discard(node_id)
+        self._publish("node_dead", {"node_id": node_id, "reason": reason})
+
+    def start_health_monitor(self):
+        if self._monitor is not None:
+            return
+
+        def loop():
+            period = max(0.05, config.gcs_heartbeat_interval_s / 2)
+            while not self._stop.wait(period):
+                timeout = config.gcs_node_timeout_s
+                now = time.monotonic()
+                with self._lock:
+                    stale = [
+                        nid for nid, info in self._nodes.items()
+                        if info["alive"] and info["address"] is not None
+                        and now - info["last_heartbeat"] > timeout
+                    ]
+                for nid in stale:
+                    self._mark_dead(nid, "missed heartbeats")
+
+        self._monitor = threading.Thread(target=loop, name="gcs-health",
+                                         daemon=True)
+        self._monitor.start()
+
+    def stop(self):
+        self._stop.set()
+
+    # ----------------------------------------------------------- placement
+
+    def place_task(self, resources: Dict[str, float],
+                   exclude: Optional[List[str]] = None) -> Optional[str]:
+        """Pick an alive node whose AVAILABLE resources fit — most-available
+        first (a spread-flavoured policy; the reference's hybrid policy packs
+        to 50% then spreads, `scheduling/policy/hybrid_scheduling_policy.h:50`).
+        Returns None when nothing fits right now."""
+        exclude = set(exclude or ())
+        best, best_score = None, None
+        with self._lock:
+            for nid, info in self._nodes.items():
+                if not info["alive"] or nid in exclude:
+                    continue
+                avail = info["resources_available"]
+                if all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in resources.items()):
+                    score = sum(avail.values()) - len(resources)
+                    if best_score is None or score > best_score:
+                        best, best_score = nid, score
+        return best
+
+    def feasible_nodes(self, resources: Dict[str, float]) -> List[str]:
+        """Nodes whose TOTAL capacity fits (for infeasibility diagnosis)."""
+        with self._lock:
+            return [
+                nid for nid, info in self._nodes.items()
+                if info["alive"] and all(
+                    info["resources_total"].get(k, 0.0) + 1e-9 >= v
+                    for k, v in resources.items())
+            ]
+
+    # ----------------------------------------------------------- kv
+
+    def kv_put(self, ns: str, key: bytes, val: bytes):
+        with self._lock:
+            self._kv[(ns, key)] = val
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get((ns, key))
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        with self._lock:
+            return self._kv.pop((ns, key), None) is not None
+
+    def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
+        with self._lock:
+            return [k for (n, k) in self._kv
+                    if n == ns and k.startswith(prefix)]
+
+    # ----------------------------------------------------------- functions
+
+    def put_function(self, fid: bytes, blob: bytes):
+        with self._lock:
+            self._functions[fid] = blob
+
+    def get_function(self, fid: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._functions.get(fid)
+
+    # ----------------------------------------------------------- actors
+
+    def register_actor(self, actor_id: bytes, owner_node: str,
+                       name: Optional[str] = None, namespace: str = "",
+                       spec_blob: Optional[bytes] = None) -> bool:
+        """False when the (namespace, name) is already taken."""
+        with self._lock:
+            if name:
+                existing = self._named.get((namespace, name))
+                if existing is not None and existing != actor_id:
+                    return False  # name collision
+            self._actors[actor_id] = {
+                "owner_node": owner_node,
+                "state": "pending",
+                "name": name,
+                "namespace": namespace,
+                "spec_blob": spec_blob,
+            }
+            if name:
+                self._named[(namespace, name)] = actor_id
+            return True
+
+    def update_actor(self, actor_id: bytes, state: str,
+                     node_id: Optional[str] = None):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info["state"] = state
+            if node_id is not None:
+                info["exec_node"] = node_id
+
+    def remove_actor(self, actor_id: bytes):
+        with self._lock:
+            info = self._actors.pop(actor_id, None)
+            if info and info.get("name"):
+                key = (info["namespace"], info["name"])
+                if self._named.get(key) == actor_id:
+                    del self._named[key]
+
+    def get_actor(self, actor_id: bytes) -> Optional[dict]:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            return dict(info) if info else None
+
+    def lookup_named_actor(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            aid = self._named.get((namespace, name))
+            if aid is None:
+                return None
+            info = dict(self._actors[aid])
+            info["actor_id"] = aid
+            return info
+
+    def list_actors(self) -> List[dict]:
+        with self._lock:
+            return [{"actor_id": aid.hex() if isinstance(aid, bytes) else aid,
+                     **{k: v for k, v in info.items() if k != "spec_blob"}}
+                    for aid, info in self._actors.items()]
+
+    # ----------------------------------------------------------- objects
+
+    def add_object_location(self, oid: str, node_id: str, size: int = 0,
+                            inline: bool = False):
+        with self._lock:
+            entry = self._objects.setdefault(
+                oid, {"nodes": set(), "size": size, "inline": inline})
+            entry["nodes"].add(node_id)
+            entry["size"] = max(entry["size"], size)
+            watchers = self._object_watchers.pop(oid, set())
+        for w in watchers:
+            self._publish("object_at", {"oid": oid, "node_id": node_id},
+                          target_node=w)
+
+    def remove_object_location(self, oid: str, node_id: Optional[str] = None):
+        with self._lock:
+            if node_id is None:
+                self._objects.pop(oid, None)
+                return
+            entry = self._objects.get(oid)
+            if entry:
+                entry["nodes"].discard(node_id)
+                if not entry["nodes"]:
+                    del self._objects[oid]
+
+    def get_object_locations(self, oid: str,
+                             watcher: Optional[str] = None) -> dict:
+        """When no location is known and ``watcher`` is given, the watcher
+        node gets an ``object_at`` push once somebody registers one
+        (reference: object directory subscriptions,
+        `ownership_based_object_directory.h`)."""
+        with self._lock:
+            entry = self._objects.get(oid)
+            if entry and entry["nodes"]:
+                return {"nodes": sorted(entry["nodes"]),
+                        "size": entry["size"], "inline": entry["inline"]}
+            if watcher is not None:
+                self._object_watchers.setdefault(oid, set()).add(watcher)
+            return {"nodes": [], "size": 0, "inline": False}
+
+    # ----------------------------------------------------------- snapshot
+
+    def state_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": [dict(n) for n in self._nodes.values()],
+                "actors": self.list_actors(),
+                "num_objects_tracked": len(self._objects),
+                "num_kv": len(self._kv),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Socket server
+
+
+_OPS = {
+    "register_node", "unregister_node", "heartbeat", "nodes", "get_node",
+    "place_task", "feasible_nodes",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "put_function", "get_function",
+    "register_actor", "update_actor", "remove_actor", "get_actor",
+    "lookup_named_actor", "list_actors",
+    "add_object_location", "remove_object_location", "get_object_locations",
+    "state_snapshot",
+}
+
+
+class GcsServer:
+    """TCP front-end for a GcsCore; one reader thread per connection."""
+
+    def __init__(self, core: Optional[GcsCore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.core = core or GcsCore()
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._conns: List[socket.socket] = []
+        self._stop = False
+        self.core.start_health_monitor()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="gcs-accept", daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(sock)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket):
+        send_lock = threading.Lock()
+        push_cb = None
+        try:
+            while True:
+                msg = protocol.recv_msg(sock)
+                if msg is None:
+                    break
+                t = msg.get("t")
+                if t == "request":
+                    rid, op = msg["rid"], msg["op"]
+                    try:
+                        if op == "subscribe":
+                            node_id = msg.get("node_id")
+
+                            def push_cb(event, data, _sl=send_lock, _s=sock):
+                                try:
+                                    protocol.send_msg(
+                                        _s, {"t": "push", "event": event,
+                                             "data": data}, _sl)
+                                except OSError:
+                                    pass
+
+                            self.core.subscribe(push_cb, node_id)
+                            value = True
+                        elif op in _OPS:
+                            value = getattr(self.core, op)(
+                                *msg.get("args", ()), **msg.get("kw", {}))
+                        else:
+                            raise ValueError(f"unknown GCS op {op}")
+                        protocol.send_msg(
+                            sock, {"t": "reply", "rid": rid, "ok": True,
+                                   "value": value}, send_lock)
+                    except Exception as e:  # noqa: BLE001
+                        try:
+                            protocol.send_msg(
+                                sock, {"t": "reply", "rid": rid, "ok": False,
+                                       "error": e}, send_lock)
+                        except OSError:
+                            break
+        finally:
+            if push_cb is not None:
+                self.core.unsubscribe(push_cb)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def shutdown(self):
+        self._stop = True
+        self.core.stop()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in self._conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class GcsClient:
+    """Socket client with the same method surface as GcsCore."""
+
+    def __init__(self, address: str,
+                 push_handler: Optional[Callable[[str, Any], None]] = None,
+                 timeout: float = 10.0,
+                 on_disconnect: Optional[Callable[[], None]] = None):
+        host, port = address.rsplit(":", 1)
+        self.address = address
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._rid = 0
+        self._rid_lock = threading.Lock()
+        self._pending: Dict[int, dict] = {}
+        self._push_handler = push_handler
+        self._on_disconnect = on_disconnect
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="gcs-client", daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while True:
+            try:
+                msg = protocol.recv_msg(self._sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                was_closed = self._closed
+                self._closed = True
+                err = ConnectionError("GCS connection lost")
+                for entry in list(self._pending.values()):
+                    entry["msg"] = {"ok": False, "error": err}
+                    entry["event"].set()
+                if not was_closed and self._on_disconnect is not None:
+                    try:
+                        self._on_disconnect()
+                    except Exception:  # noqa: BLE001
+                        traceback.print_exc()
+                return
+            if msg.get("t") == "reply":
+                entry = self._pending.pop(msg["rid"], None)
+                if entry is not None:
+                    entry["msg"] = msg
+                    entry["event"].set()
+            elif msg.get("t") == "push" and self._push_handler is not None:
+                try:
+                    self._push_handler(msg["event"], msg["data"])
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+
+    def _call(self, op: str, *args, **kw):
+        if self._closed:
+            raise ConnectionError("GCS connection lost")
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        entry = {"event": threading.Event(), "msg": None}
+        self._pending[rid] = entry
+        protocol.send_msg(
+            self._sock,
+            {"t": "request", "rid": rid, "op": op, "args": args, "kw": kw},
+            self._send_lock)
+        if not entry["event"].wait(60.0):
+            self._pending.pop(rid, None)
+            raise TimeoutError(f"GCS op {op} timed out")
+        msg = entry["msg"]
+        if not msg["ok"]:
+            raise msg["error"]
+        return msg["value"]
+
+    def post(self, op: str, *args, **kw):
+        """Fire-and-forget: send the request without registering a pending
+        reply (the server's reply is dropped by the reader).  For hot-path
+        metadata updates (object locations, actor states) where a blocking
+        round-trip from the raylet event thread would serialize completions
+        on GCS latency."""
+        if self._closed:
+            raise ConnectionError("GCS connection lost")
+        with self._rid_lock:
+            self._rid += 1
+            rid = self._rid
+        protocol.send_msg(
+            self._sock,
+            {"t": "request", "rid": rid, "op": op, "args": args, "kw": kw},
+            self._send_lock)
+
+    def subscribe_remote(self, node_id: Optional[str] = None):
+        return self._call("subscribe", node_id=node_id)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __getattr__(self, op):
+        if op in _OPS:
+            return lambda *a, **kw: self._call(op, *a, **kw)
+        raise AttributeError(op)

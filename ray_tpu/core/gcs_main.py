@@ -1,0 +1,40 @@
+"""Standalone GCS server process (reference:
+`src/ray/gcs/gcs_server/gcs_server_main.cc`).
+
+Prints ``GCS_ADDRESS host:port`` on stdout once listening so launchers
+(`ray_tpu/cluster_utils.py`, the CLI) can read the bound ephemeral port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    from ray_tpu.core.gcs import GcsServer
+
+    server = GcsServer(host=args.host, port=args.port)
+    print(f"GCS_ADDRESS {server.address}", flush=True)
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

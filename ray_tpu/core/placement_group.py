@@ -94,7 +94,7 @@ def remove_placement_group(pg: PlacementGroup):
     worker = global_worker()
     if worker.mode == "driver":
         worker.raylet.call(worker.raylet.remove_pg, pg.id.hex()).result()
-    elif worker.mode == "worker":
+    elif worker.mode in ("worker", "client"):
         worker._request("remove_pg", pg_id=pg.id.hex())
 
 

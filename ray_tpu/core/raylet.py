@@ -42,9 +42,11 @@ from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    ObjectLostError,
     TaskError,
     WorkerCrashedError,
 )
+from ray_tpu.core.gcs import GcsClient, GcsCore
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.task_spec import (
     ACTOR_CREATION_TASK,
@@ -52,6 +54,13 @@ from ray_tpu.core.task_spec import (
     NORMAL_TASK,
     TaskSpec,
 )
+
+config.define("spillback_max_hops", int, 4,
+              "Max times a task may be forwarded between nodes before it "
+              "must queue where it is (guards forward ping-pong).")
+config.define("object_transfer_chunk_bytes", int, 4 << 20,
+              "Chunk size for raylet-to-raylet object pulls (reference: "
+              "chunked gRPC push/pull, object_manager.h:117).")
 
 # ---------------------------------------------------------------------------
 
@@ -106,13 +115,32 @@ class _WorkerConn:
 
 
 class _ObjectState:
-    __slots__ = ("status", "value", "error", "size")
+    __slots__ = ("status", "value", "error", "size", "locations")
 
     def __init__(self):
-        self.status = "pending"  # pending | inline | store | error
+        # pending | inline | store | remote | error
+        # "remote": sealed in another node's store/raylet (cluster mode) —
+        # satisfies dependency gating (the task can be forwarded to the
+        # data) but must be pulled before LOCAL dispatch or get().
+        self.status = "pending"
         self.value: Optional[bytes] = None
         self.error: Optional[Exception] = None
         self.size = 0
+        self.locations: List[str] = []
+
+
+class _PeerConn:
+    """Connection to another raylet (either dialed or accepted)."""
+
+    __slots__ = ("sock", "node_id", "send_lock")
+
+    def __init__(self, sock, node_id: str):
+        self.sock = sock
+        self.node_id = node_id
+        self.send_lock = threading.Lock()
+
+    def send(self, msg):
+        protocol.send_msg(self.sock, msg, self.send_lock)
 
 
 class _ActorState:
@@ -121,6 +149,13 @@ class _ActorState:
         self.creation_spec = spec
         self.name = name
         self.state = "pending"  # pending | alive | restarting | dead
+        # Cluster mode: node the actor executes on when it was spilled to a
+        # peer raylet (this raylet stays the OWNER: it holds the state
+        # machine and the restart budget, the exec node reports deaths).
+        self.node_id: Optional[str] = None
+        # Set on the EXEC side of a forwarded actor: the owner node id
+        # (deaths are reported there instead of restarting locally).
+        self.foreign_owner: Optional[str] = None
         self.conn: Optional[_WorkerConn] = None
         self.queue: deque = deque()  # pending method TaskSpecs (FIFO order)
         # In-flight calls — up to max_concurrency simultaneously (reference:
@@ -173,7 +208,20 @@ class Raylet:
         resources: Dict[str, float],
         store_path: Optional[str],
         worker_env: Optional[Dict[str, str]] = None,
+        gcs: Optional[GcsCore] = None,
+        gcs_address: Optional[str] = None,
+        node_ip: str = "127.0.0.1",
+        listen_port: Optional[int] = None,
     ):
+        """Single-node (default): embedded ``GcsCore``, unix socket only.
+
+        Cluster mode (``listen_port`` not None, usually 0 = ephemeral): also
+        listens on TCP for peer raylets and remote drivers, registers the
+        node with the GCS (remote via ``gcs_address`` or a shared in-process
+        core via ``gcs``), heartbeats resources, spills tasks to peers and
+        pulls remote objects (reference: `src/ray/raylet/main.cc:109` node
+        bring-up + `scheduling/cluster_task_manager.cc:44` spillback).
+        """
         self.session_dir = session_dir
         self.socket_path = os.path.join(session_dir, "raylet.sock")
         self.store_path = store_path
@@ -181,6 +229,9 @@ class Raylet:
         self.resources_available = dict(resources)
         self.worker_env = worker_env or {}
         self.node_id = WorkerID.from_random().hex()
+        self.node_ip = node_ip
+        self.gcs_address = gcs_address
+        self.cluster_mode = listen_port is not None
 
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         if os.path.exists(self.socket_path):
@@ -188,6 +239,27 @@ class Raylet:
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
         self._listener.setblocking(False)
+
+        self._tcp_listener = None
+        self.tcp_port = None
+        if self.cluster_mode:
+            self._tcp_listener = socket.create_server(
+                (node_ip, listen_port), backlog=128)
+            self._tcp_listener.setblocking(False)
+            self.tcp_port = self._tcp_listener.getsockname()[1]
+
+        # Control plane: remote GCS (cluster), shared core (in-process
+        # multi-raylet tests), or a private embedded core (single node).
+        # A standalone raylet whose GCS dies must not linger as an orphan
+        # tree of workers (reference raylets exit when the GCS is
+        # unreachable); ``on_fatal`` lets the hosting process (raylet_main)
+        # exit its wait loop.
+        self.on_fatal: Optional[Callable[[], None]] = None
+        if gcs_address is not None:
+            self.gcs = GcsClient(gcs_address, push_handler=self._gcs_push,
+                                 on_disconnect=self._on_gcs_lost)
+        else:
+            self.gcs = gcs if gcs is not None else GcsCore()
 
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
@@ -197,6 +269,9 @@ class Raylet:
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
         self._sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        if self._tcp_listener is not None:
+            self._sel.register(self._tcp_listener, selectors.EVENT_READ,
+                               ("accept", None))
 
         # state (event-thread owned)
         self._workers: Dict[socket.socket, _WorkerConn] = {}
@@ -211,18 +286,44 @@ class Raylet:
         self._objects: Dict[ObjectID, _ObjectState] = {}
         self._object_waiters: Dict[ObjectID, List[Callable]] = {}
         self._actors: Dict[ActorID, _ActorState] = {}
-        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._pgs: Dict[str, _PlacementGroup] = {}
-        self._kv: Dict[Tuple[str, bytes], bytes] = {}
-        self._function_table: Dict[bytes, bytes] = {}
+        # Local write-through cache of the GCS function table (hot path:
+        # every dispatch of a large function looks its blob up).
+        self._fn_cache: Dict[bytes, bytes] = {}
         self._timers: List[Tuple[float, int, Callable]] = []
         self._timer_seq = itertools.count()
         self._task_events: deque = deque(maxlen=config.task_event_buffer_size)
         self._task_states: Dict[TaskID, dict] = {}
         self._shutdown = False
 
+        # ---- cluster state (all event-thread owned) ----
+        self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
+        self._cluster_nodes: Dict[str, dict] = {}       # node_id -> gcs info
+        self._forwarded: Dict[TaskID, Tuple[TaskSpec, str]] = {}
+        self._actor_owner_cache: Dict[ActorID, str] = {}
+        self._pulls: Dict[ObjectID, dict] = {}          # oid -> pull state
+        self._pull_by_rid: Dict[int, ObjectID] = {}
+        self._pull_rid = itertools.count(1)
+        self._store = None  # raylet's own store client (pull serving/writing)
+
+        if isinstance(self.gcs, GcsCore):
+            # In-process core: subscribe directly; pushes hop to the loop.
+            self.gcs.subscribe(self._gcs_push, node_id=self.node_id)
+        else:
+            self.gcs.subscribe_remote(node_id=self.node_id)
+        address = (node_ip, self.tcp_port) if self.cluster_mode else None
+        for info in self.gcs.register_node(
+                self.node_id, address, self.resources_total,
+                store_path=store_path, hostname=socket.gethostname()):
+            if info["node_id"] != self.node_id and info["alive"]:
+                self._cluster_nodes[info["node_id"]] = info
+
         self._thread = threading.Thread(target=self._run, name="raylet", daemon=True)
         self._thread.start()
+        if self.cluster_mode:
+            self.call_async(
+                lambda: self.add_timer(config.gcs_heartbeat_interval_s,
+                                       self._heartbeat))
 
     # ------------------------------------------------------------------ API
     # Called from the driver thread; closures run on the event thread.
@@ -265,7 +366,13 @@ class Raylet:
             for key, _ in events:
                 kind, conn = key.data
                 if kind == "accept":
-                    self._accept()
+                    self._accept(key.fileobj)
+                elif kind == "peer":
+                    try:
+                        self._on_peer_readable(conn)
+                    except Exception:  # noqa: BLE001
+                        traceback.print_exc()
+                        self._safe(lambda c=conn: self._drop_peer(c))
                 elif kind == "wake":
                     try:
                         self._wake_r.recv(4096)
@@ -287,6 +394,11 @@ class Raylet:
                 conn.sock.close()
             except OSError:
                 pass
+        for peer in list(self._peers.values()):
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
         for p in self._procs:
             try:
                 p.terminate()
@@ -297,6 +409,16 @@ class Raylet:
             os.unlink(self.socket_path)
         except OSError:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except OSError:
+                pass
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     def _safe(self, fn):
         try:
@@ -322,12 +444,19 @@ class Raylet:
             self._timers, (time.monotonic() + delay, next(self._timer_seq), cb)
         )
 
-    def _accept(self):
+    def _accept(self, listener):
         try:
-            sock, _ = self._listener.accept()
+            sock, _ = listener.accept()
         except OSError:
             return
         sock.setblocking(True)
+        if listener is self._tcp_listener:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        # Starts as a worker conn; a peer_hello / driver_hello first message
+        # re-tags it (peers are other raylets, drivers are remote clients).
         conn = _WorkerConn(sock, profile="cpu")
         self._workers[sock] = conn
         self._sel.register(sock, selectors.EVENT_READ, ("worker", conn))
@@ -471,6 +600,20 @@ class Raylet:
 
     def _handle_worker_msg(self, conn: _WorkerConn, msg: dict):
         t = msg["t"]
+        if t == "peer_hello":
+            # Another raylet dialed us: promote the conn to a peer channel.
+            peer = _PeerConn(conn.sock, msg["node_id"])
+            self._workers.pop(conn.sock, None)
+            self._sel.modify(conn.sock, selectors.EVENT_READ, ("peer", peer))
+            self._peers.setdefault(msg["node_id"], peer)
+            return
+        if t == "driver_hello":
+            conn.state = "driver"
+            conn.send({"t": "hello_reply", "node_id": self.node_id,
+                       "store_path": self.store_path,
+                       "session_dir": self.session_dir,
+                       "gcs_address": self.gcs_address})
+            return
         if t == "register":
             conn.worker_id = msg["worker_id"]
             conn.pid = msg["pid"]
@@ -522,10 +665,13 @@ class Raylet:
             else:
                 inline: Dict[str, bytes] = msg.get("inline", {})
                 stored: List[str] = msg.get("stored", [])
+                sizes: Dict[str, int] = msg.get("sizes", {})
                 for hex_id, blob in inline.items():
                     self._object_inline(ObjectID.from_hex(hex_id), blob)
                 for hex_id in stored:
-                    self._object_in_store(ObjectID.from_hex(hex_id))
+                    oid = ObjectID.from_hex(hex_id)
+                    self._obj(oid).size = sizes.get(hex_id, 0)
+                    self._object_in_store(oid)
                 self._record_event(spec, "FINISHED")
         # worker back to pool / actor next call
         if spec.kind == ACTOR_CREATION_TASK:
@@ -542,6 +688,7 @@ class Raylet:
             else:
                 actor.state = "alive"
                 actor.conn = conn
+                actor.node_id = None  # executing locally, whatever was tried
                 conn.state = "actor"
         elif actor is not None:
             if not conn.inflight:
@@ -558,6 +705,528 @@ class Raylet:
             self._pump_actor(actor)
         self._schedule()
 
+    # --------------------------------------------------------------- cluster
+
+    def _heartbeat(self):
+        try:
+            ok = self.gcs.heartbeat(self.node_id, self.resources_available,
+                                    queue_len=len(self._ready_queue))
+            if not ok:
+                # GCS lost track of us (restart / marked dead): re-register.
+                self.gcs.register_node(
+                    self.node_id, (self.node_ip, self.tcp_port),
+                    self.resources_total, store_path=self.store_path,
+                    hostname=socket.gethostname())
+        except (ConnectionError, TimeoutError, OSError):
+            pass
+        if not self._shutdown:
+            self.add_timer(config.gcs_heartbeat_interval_s, self._heartbeat)
+
+    def _gcs_push(self, event: str, data):
+        """Runs on the GCS client/reader thread — hop to the event loop."""
+        self.call_async(self._on_gcs_event, event, data)
+
+    def _on_gcs_lost(self):
+        """GCS connection dropped (reader thread): the node is partitioned
+        from the control plane — shut down rather than orphan the worker
+        tree."""
+        if self._shutdown:
+            return
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: GCS connection lost — "
+            "shutting down\n")
+        self._shutdown = True
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+        if self.on_fatal is not None:
+            self._safe(self.on_fatal)
+
+    def _on_gcs_event(self, event: str, data):
+        if event == "node_added":
+            nid = data["node_id"]
+            if nid != self.node_id:
+                self._cluster_nodes[nid] = data
+            self._schedule()
+        elif event == "node_dead":
+            self._on_node_death(data["node_id"], data.get("reason", ""))
+        elif event == "object_at":
+            oid = ObjectID.from_hex(data["oid"])
+            st = self._objects.get(oid)
+            if st is not None and st.status == "pending":
+                st.status = "remote"
+                st.locations = [data["node_id"]]
+                self._object_ready(oid)
+            if oid in self._object_waiters or oid in self._dep_index:
+                self._maybe_pull(oid)
+
+    def _on_node_death(self, node_id: str, reason: str):
+        self._cluster_nodes.pop(node_id, None)
+        peer = self._peers.pop(node_id, None)
+        if peer is not None:
+            try:
+                self._sel.unregister(peer.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
+        # In-flight pulls from the dead node: retry elsewhere.
+        for oid, pull in list(self._pulls.items()):
+            if pull["node"] == node_id:
+                self._pull_by_rid.pop(pull["rid"], None)
+                del self._pulls[oid]
+                st = self._objects.get(oid)
+                if st is not None and node_id in st.locations:
+                    st.locations.remove(node_id)
+                self._maybe_pull(oid, force_lookup=True)
+        # Remote objects whose only copy died: lost (lineage reconstruction
+        # re-runs the creating task when ownership tracking lands).
+        for oid, st in list(self._objects.items()):
+            if st.status != "remote":
+                continue
+            if node_id in st.locations:
+                st.locations.remove(node_id)
+            if not st.locations:
+                self._object_error(oid, ObjectLostError(
+                    f"object {oid.hex()} was on node {node_id} which died"))
+        # Forwarded tasks: retry like a worker crash (actor tasks fail — the
+        # actor itself restarts below and interrupted calls error).
+        for tid, (spec, nid) in list(self._forwarded.items()):
+            if nid != node_id:
+                continue
+            del self._forwarded[tid]
+            if spec.kind == ACTOR_CREATION_TASK:
+                continue  # handled via the actor scan below
+            if spec.kind == ACTOR_TASK:
+                err = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "?",
+                    f"node {node_id} died")
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "FAILED", node_died=True)
+            elif spec.retries_left > 0:
+                spec.retries_left -= 1
+                self._record_event(spec, "RETRYING", node_died=True)
+                self._enqueue_ready(spec)
+            else:
+                err = WorkerCrashedError(
+                    f"node {node_id} died while running {spec.name}")
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "FAILED", node_died=True)
+        # Actors executing on the dead node: restart per budget.
+        for actor in list(self._actors.values()):
+            if actor.node_id == node_id and actor.state != "dead":
+                actor.node_id = None
+                self._on_actor_death(actor.actor_id,
+                                     f"node {node_id} died ({reason})")
+        self._schedule()
+
+    def _drop_peer(self, peer: _PeerConn):
+        """Socket-level failure on a peer conn: close it; real node death is
+        decided by the GCS health monitor, not by one broken socket."""
+        try:
+            self._sel.unregister(peer.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            peer.sock.close()
+        except OSError:
+            pass
+        if self._peers.get(peer.node_id) is peer:
+            del self._peers[peer.node_id]
+
+    def _get_peer(self, node_id: str) -> Optional[_PeerConn]:
+        peer = self._peers.get(node_id)
+        if peer is not None:
+            return peer
+        info = self._cluster_nodes.get(node_id)
+        if info is None or not info.get("address"):
+            try:
+                info = self.gcs.get_node(node_id)
+            except (ConnectionError, TimeoutError, OSError):
+                info = None
+            if info is None or not info.get("alive") or not info.get("address"):
+                return None
+            self._cluster_nodes[node_id] = info
+        try:
+            sock = socket.create_connection(tuple(info["address"]), timeout=5)
+        except OSError:
+            return None
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setblocking(True)
+        peer = _PeerConn(sock, node_id)
+        self._peers[node_id] = peer
+        self._sel.register(sock, selectors.EVENT_READ, ("peer", peer))
+        peer.send({"t": "peer_hello", "node_id": self.node_id})
+        return peer
+
+    def _on_peer_readable(self, peer: _PeerConn):
+        try:
+            msg = protocol.recv_msg(peer.sock)
+        except OSError:
+            msg = None
+        if msg is None:
+            self._drop_peer(peer)
+            return
+        self._handle_peer_msg(peer, msg)
+
+    def _handle_peer_msg(self, peer: _PeerConn, msg: dict):
+        t = msg["t"]
+        if t == "xtask":
+            self._handle_xtask(peer, msg)
+        elif t == "xdone":
+            self._handle_xdone(msg)
+        elif t == "xactor_death":
+            self._handle_xactor_death(msg)
+        elif t == "xkill":
+            self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+        elif t == "pull":
+            self._handle_pull(peer, msg)
+        elif t == "pull_meta":
+            self._handle_pull_meta(msg)
+        elif t == "chunk":
+            self._handle_pull_chunk(msg)
+        elif t == "pull_err":
+            self._handle_pull_err(msg)
+
+    # ---- task forwarding (spillback / actor routing) ----
+
+    def _forward_task(self, spec: TaskSpec, node_id: str) -> bool:
+        peer = self._get_peer(node_id)
+        if peer is None:
+            return False
+        inline_deps: Dict[str, bytes] = {}
+        store_deps: Dict[str, str] = {}
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            if st is None:
+                continue
+            if st.status == "inline":
+                inline_deps[oid.hex()] = st.value
+            elif st.status == "store":
+                store_deps[oid.hex()] = self.node_id
+            elif st.status == "remote" and st.locations:
+                store_deps[oid.hex()] = st.locations[0]
+        spec._acquired_pool = None
+        spec._spill_count = getattr(spec, "_spill_count", 0) + 1
+        self._forwarded[spec.task_id] = (spec, node_id)
+        if spec.kind == ACTOR_CREATION_TASK:
+            actor = self._actors.get(spec.actor_id)
+            if actor is not None:
+                actor.node_id = node_id  # tentative; confirmed by xdone
+        self._record_event(spec, "SPILLED", to_node=node_id)
+        try:
+            peer.send({"t": "xtask", "spec": spec,
+                       "inline_deps": inline_deps,
+                       "store_deps": store_deps, "origin": self.node_id})
+        except OSError:
+            del self._forwarded[spec.task_id]
+            if spec.kind == ACTOR_CREATION_TASK:
+                actor = self._actors.get(spec.actor_id)
+                if actor is not None and actor.node_id == node_id:
+                    actor.node_id = None  # roll back the tentative placement
+            self._drop_peer(peer)
+            return False
+        return True
+
+    def _handle_xtask(self, peer: _PeerConn, msg: dict):
+        spec: TaskSpec = msg["spec"]
+        origin: str = msg["origin"]
+        for h, blob in (msg.get("inline_deps") or {}).items():
+            oid = ObjectID.from_hex(h)
+            if self._object_status(oid) not in ("inline", "store"):
+                self._object_inline(oid, blob)
+        for h, node in (msg.get("store_deps") or {}).items():
+            oid = ObjectID.from_hex(h)
+            st = self._obj(oid)
+            if st.status == "pending":
+                st.status = "remote"
+                st.locations = [node]
+        # Route the results back the moment every return resolves — this
+        # catches every completion path (inline/store/error) with the same
+        # machinery local get() uses.
+        self.async_get(
+            spec.return_ids(),
+            lambda results, s=spec, o=origin: self._xdone_cb(o, s, results))
+        self.submit_task(spec, foreign_origin=origin)
+
+    def _xdone_cb(self, origin: str, spec: TaskSpec, results: Dict[str, tuple]):
+        peer = self._get_peer(origin)
+        if peer is None:
+            return  # origin node is gone; results stay locally
+        out = {}
+        for h, r in results.items():
+            if r[0] == "store":
+                out[h] = ("store", self.node_id)
+            else:
+                out[h] = r
+        try:
+            peer.send({"t": "xdone", "task_id": spec.task_id, "results": out})
+        except OSError:
+            self._drop_peer(peer)
+
+    def _handle_xdone(self, msg: dict):
+        entry = self._forwarded.pop(msg["task_id"], None)
+        spec = entry[0] if entry else None
+        failed = False
+        for h, r in msg["results"].items():
+            oid = ObjectID.from_hex(h)
+            if r[0] == "inline":
+                self._object_inline(oid, r[1])
+            elif r[0] == "error":
+                failed = True
+                self._object_error(oid, r[1])
+            else:  # ("store", node_id)
+                st = self._obj(oid)
+                if st.status in ("pending", "remote"):
+                    st.status = "remote"
+                    if r[1] not in st.locations:
+                        st.locations.append(r[1])
+                    self._object_ready(oid)
+        if spec is None:
+            return
+        self._record_event(spec, "FAILED" if failed else "FINISHED",
+                           remote=True)
+        if spec.kind == ACTOR_CREATION_TASK:
+            actor = self._actors.get(spec.actor_id)
+            if actor is not None:
+                if failed:
+                    actor.node_id = None
+                    self._on_actor_death(spec.actor_id,
+                                         "creation task failed",
+                                         allow_restart=False)
+                else:
+                    actor.state = "alive"
+                    actor.node_id = entry[1]
+                    if self.cluster_mode:
+                        self._gcs_post("update_actor",
+                                       spec.actor_id.binary(), "alive",
+                                       node_id=entry[1])
+                    self._pump_actor(actor)
+
+    def _handle_xactor_death(self, msg: dict):
+        actor = self._actors.get(msg["actor_id"])
+        if actor is None or actor.state == "dead":
+            return
+        actor.node_id = None
+        self._on_actor_death(msg["actor_id"], msg.get("reason", "died"))
+
+    def _gcs_safe(self, fn, *args, **kw):
+        try:
+            return fn(*args, **kw)
+        except (ConnectionError, TimeoutError, OSError):
+            return None
+
+    def _gcs_post(self, op: str, *args, **kw):
+        """One-way GCS update (no reply wait) — keeps the event thread off
+        GCS round-trips on per-object hot paths."""
+        try:
+            if isinstance(self.gcs, GcsClient):
+                self.gcs.post(op, *args, **kw)
+            else:
+                getattr(self.gcs, op)(*args, **kw)
+        except (ConnectionError, TimeoutError, OSError):
+            pass
+
+    # ---- chunked object pulls (reference: pull_manager.h:52) ----
+
+    def _raylet_store(self):
+        if self._store is None and self.store_path:
+            from ray_tpu.core.object_store import ShmObjectStore
+
+            self._store = ShmObjectStore(self.store_path)
+        return self._store
+
+    def _handle_pull(self, peer: _PeerConn, msg: dict):
+        """Serve an object to a peer: inline blob in one frame, store bytes
+        as a pull_meta + chunk stream.
+
+        The chunk stream is sent from a DEDICATED thread: a blocking
+        sendall on the event thread would stop this raylet from reading its
+        own sockets — two raylets pulling large objects from each other
+        would deadlock on full TCP buffers.  The store read is thread-safe
+        (pin via get_buffer / release when done); _objects is only touched
+        here on the event thread.
+        """
+        rid = msg["rid"]
+        oid = ObjectID.from_hex(msg["id"])
+        st = self._objects.get(oid)
+        inline_value = st.value if (st is not None and st.status == "inline") \
+            else None
+        store = self._raylet_store()
+
+        def stream():
+            try:
+                if inline_value is not None:
+                    peer.send({"t": "pull_meta", "rid": rid, "kind": "inline",
+                               "size": len(inline_value)})
+                    peer.send({"t": "chunk", "rid": rid, "data": inline_value,
+                               "eof": True})
+                    return
+                buf = store.get_buffer(oid) if store is not None else None
+                if buf is None:
+                    peer.send({"t": "pull_err", "rid": rid,
+                               "error": f"object {oid.hex()} not here"})
+                    return
+                try:
+                    size = len(buf)
+                    peer.send({"t": "pull_meta", "rid": rid, "kind": "store",
+                               "size": size})
+                    chunk = config.object_transfer_chunk_bytes
+                    for off in range(0, size, chunk):
+                        peer.send({"t": "chunk", "rid": rid,
+                                   "data": bytes(buf[off:off + chunk]),
+                                   "eof": off + chunk >= size})
+                    if size == 0:
+                        peer.send({"t": "chunk", "rid": rid, "data": b"",
+                                   "eof": True})
+                finally:
+                    del buf
+                    store.release(oid)
+            except OSError:
+                self.call_async(self._drop_peer, peer)
+
+        threading.Thread(target=stream, name="pull-stream",
+                         daemon=True).start()
+
+    def _maybe_pull(self, oid: ObjectID, force_lookup: bool = False):
+        """Start fetching a non-local object. Location from local metadata,
+        else the GCS directory (registering a watch when unknown)."""
+        if not self.cluster_mode:
+            return
+        st = self._obj(oid)
+        if st.status not in ("pending", "remote") or oid in self._pulls:
+            return
+        if st.status == "pending" or force_lookup or not st.locations:
+            loc = self._gcs_safe(self.gcs.get_object_locations, oid.hex(),
+                                 watcher=self.node_id)
+            if not loc or not loc["nodes"]:
+                return  # watch registered; object_at retriggers us
+            st.locations = [n for n in loc["nodes"] if n != self.node_id]
+            if not st.locations:
+                return
+            if st.status == "pending":
+                st.status = "remote"
+        target = st.locations[0]
+        peer = self._get_peer(target)
+        if peer is None:
+            # Unreachable holder: drop it from the directory too (else a
+            # force_lookup keeps returning the same node until the GCS
+            # health timeout) and retry on a timer rather than recursing.
+            st.locations.remove(target)
+            self._gcs_post("remove_object_location", oid.hex(), target)
+            if st.locations:
+                self._maybe_pull(oid)
+            else:
+                st.status = "pending"
+                self.add_timer(0.5, lambda: self._maybe_pull(
+                    oid, force_lookup=True))
+            return
+        rid = next(self._pull_rid)
+        self._pulls[oid] = {"rid": rid, "node": target, "kind": None,
+                            "buf": None, "mv": None, "off": 0, "oid": oid}
+        self._pull_by_rid[rid] = oid
+        try:
+            peer.send({"t": "pull", "rid": rid, "id": oid.hex()})
+        except OSError:
+            self._pull_by_rid.pop(rid, None)
+            self._pulls.pop(oid, None)
+            self._drop_peer(peer)
+
+    def _handle_pull_meta(self, msg: dict):
+        oid = self._pull_by_rid.get(msg["rid"])
+        if oid is None:
+            return
+        pull = self._pulls[oid]
+        pull["kind"] = msg["kind"]
+        pull["size"] = msg["size"]
+        if msg["kind"] == "store" and msg["size"] > 0:
+            store = self._raylet_store()
+            try:
+                pull["mv"] = store.create(oid, msg["size"])
+            except FileExistsError:
+                pass  # already local (raced another pull path)
+            except Exception:  # noqa: BLE001  (store full etc.)
+                pull["mv"] = None
+        if pull["kind"] == "inline" or pull["mv"] is None:
+            pull["buf"] = bytearray()
+
+    def _handle_pull_chunk(self, msg: dict):
+        oid = self._pull_by_rid.get(msg["rid"])
+        if oid is None:
+            return
+        pull = self._pulls[oid]
+        data = msg["data"]
+        if pull.get("mv") is not None:
+            mv = pull["mv"]
+            mv[pull["off"]:pull["off"] + len(data)] = data
+            pull["off"] += len(data)
+        elif pull.get("buf") is not None:
+            pull["buf"] += data
+        if not msg.get("eof"):
+            return
+        # complete
+        self._pull_by_rid.pop(msg["rid"], None)
+        del self._pulls[oid]
+        st = self._obj(oid)
+        if pull["kind"] == "inline":
+            self._object_inline(oid, bytes(pull["buf"]))
+            return
+        store = self._raylet_store()
+        if pull.get("mv") is not None:
+            del pull["mv"]
+            store.seal(oid)
+            store.release(oid)
+        elif store is not None:
+            try:
+                mv = store.create(oid, len(pull["buf"]))
+                mv[:] = pull["buf"]
+                del mv
+                store.seal(oid)
+                store.release(oid)
+            except FileExistsError:
+                pass
+            except Exception:  # noqa: BLE001
+                self._object_error(oid, ObjectLostError(
+                    f"no store capacity for pulled object {oid.hex()}"))
+                return
+        self._object_in_store(oid)
+
+    def _handle_pull_err(self, msg: dict):
+        oid = self._pull_by_rid.pop(msg["rid"], None)
+        if oid is None:
+            return
+        pull = self._pulls.pop(oid, None)
+        st = self._objects.get(oid)
+        if st is not None and pull is not None:
+            if pull["node"] in st.locations:
+                st.locations.remove(pull["node"])
+            self._gcs_post("remove_object_location", oid.hex(),
+                           pull["node"])
+            if st.status == "remote":
+                if st.locations:
+                    self._maybe_pull(oid)
+                else:
+                    st.status = "pending"
+                    self._maybe_pull(oid, force_lookup=True)
+
+    def _remote_deps_pending(self, spec: TaskSpec) -> bool:
+        """True when some dependency is not locally materialized — triggers
+        the pulls; the task re-enters dispatch when they land.  ("pending"
+        can appear here too when a holder node died after dep gating.)"""
+        pending = False
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            status = st.status if st is not None else "pending"
+            if status not in ("inline", "store", "error"):
+                self._maybe_pull(oid)
+                pending = True
+        return pending
+
     # --------------------------------------------------------------- objects
 
     def _obj(self, oid: ObjectID) -> _ObjectState:
@@ -572,11 +1241,17 @@ class Raylet:
         st.status = "inline"
         st.value = blob
         st.size = len(blob)
+        if self.cluster_mode:
+            self._gcs_post("add_object_location", oid.hex(),
+                           self.node_id, len(blob), inline=True)
         self._object_ready(oid)
 
     def _object_in_store(self, oid: ObjectID):
         st = self._obj(oid)
         st.status = "store"
+        if self.cluster_mode:
+            self._gcs_post("add_object_location", oid.hex(),
+                           self.node_id, st.size)
         self._object_ready(oid)
 
     def _object_error(self, oid: ObjectID, err: Exception):
@@ -587,6 +1262,7 @@ class Raylet:
 
     def _object_ready(self, oid: ObjectID):
         st = self._objects.get(oid)
+        status = st.status if st is not None else "pending"
         dep_error = st.error if (st is not None and st.status == "error") else None
         # unblock dependent tasks
         waiting = self._dep_index.pop(oid, None)
@@ -613,9 +1289,14 @@ class Raylet:
                 if not missing:
                     del self._waiting[task_id]
                     self._enqueue_ready(spec)
-        # fire get/wait callbacks
-        for cb in self._object_waiters.pop(oid, []):
-            self._safe(lambda cb=cb: cb(oid))
+        # fire get/wait callbacks — only when LOCALLY resolved; a "remote"
+        # transition keeps waiters registered (they resolve when the pull
+        # seals the object here) but must kick the pull off.
+        if status in ("inline", "store", "error"):
+            for cb in self._object_waiters.pop(oid, []):
+                self._safe(lambda cb=cb: cb(oid))
+        elif status == "remote" and oid in self._object_waiters:
+            self._maybe_pull(oid)
         self._schedule()
 
     def _object_status(self, oid: ObjectID) -> str:
@@ -624,24 +1305,41 @@ class Raylet:
 
     # --------------------------------------------------------------- submission
 
-    def submit_task(self, spec: TaskSpec):
-        """Entry point for driver and nested worker submissions."""
+    def submit_task(self, spec: TaskSpec, foreign_origin: Optional[str] = None):
+        """Entry point for driver and nested worker submissions.
+
+        ``foreign_origin``: this spec was forwarded here by another raylet
+        (which stays the owner of actors and handles restarts); skip the
+        owner-side registrations.
+        """
         for oid in spec.return_ids():
             self._obj(oid)
         if spec.kind == ACTOR_CREATION_TASK:
             actor = _ActorState(spec, name=(spec.placement or {}).get("name"))
             self._actors[spec.actor_id] = actor
-            if actor.name:
-                key = ((spec.placement or {}).get("namespace", ""), actor.name)
-                if key in self._named_actors:
-                    err = ValueError(f"actor name {actor.name!r} already taken")
-                    for oid in spec.return_ids():
-                        self._object_error(oid, err)
-                    return
-                self._named_actors[key] = spec.actor_id
+            if foreign_origin is not None:
+                # exec-side state: the owner restarts, we only report deaths
+                actor.restarts_left = 0
+                actor.foreign_owner = foreign_origin
+            else:
+                namespace = (spec.placement or {}).get("namespace", "")
+                if actor.name or self.cluster_mode:
+                    import cloudpickle as _cp
+
+                    ok = self._gcs_safe(
+                        self.gcs.register_actor, spec.actor_id.binary(),
+                        self.node_id, name=actor.name, namespace=namespace,
+                        spec_blob=_cp.dumps(spec) if actor.name else None)
+                    if ok is False:
+                        del self._actors[spec.actor_id]
+                        err = ValueError(
+                            f"actor name {actor.name!r} already taken")
+                        for oid in spec.return_ids():
+                            self._object_error(oid, err)
+                        return
         missing = {
-            oid for oid in spec.dependency_ids() if self._object_status(oid) != "inline"
-            and self._object_status(oid) != "store"
+            oid for oid in spec.dependency_ids()
+            if self._object_status(oid) not in ("inline", "store", "remote")
         }
         # error deps propagate immediately
         for oid in list(missing):
@@ -656,6 +1354,11 @@ class Raylet:
             self._waiting[spec.task_id] = (spec, missing)
             for oid in missing:
                 self._dep_index.setdefault(oid, set()).add(spec.task_id)
+            if self.cluster_mode:
+                # A dep produced on another node resolves via the GCS
+                # directory watch the pull registers.
+                for oid in missing:
+                    self._maybe_pull(oid)
         else:
             self._enqueue_ready(spec)
         self._schedule()
@@ -663,10 +1366,20 @@ class Raylet:
     def _enqueue_ready(self, spec: TaskSpec):
         if spec.kind == ACTOR_TASK:
             actor = self._actors.get(spec.actor_id)
-            if actor is None or actor.state == "dead":
+            if actor is None:
+                if self.cluster_mode and self._route_foreign_actor_task(spec):
+                    return
                 err = ActorDiedError(
                     spec.actor_id.hex() if spec.actor_id else "?",
-                    actor.death_reason if actor else "unknown actor",
+                    "unknown actor",
+                )
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                return
+            if actor.state == "dead":
+                err = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "?",
+                    actor.death_reason,
                 )
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
@@ -675,6 +1388,22 @@ class Raylet:
             self._pump_actor(actor)
         else:
             self._ready_queue.append(spec)
+
+    def _route_foreign_actor_task(self, spec: TaskSpec) -> bool:
+        """An actor task for an actor owned by another raylet (its handle
+        travelled here inside args / via get_actor): forward to the owner."""
+        owner = self._actor_owner_cache.get(spec.actor_id)
+        if owner is None:
+            info = self._gcs_safe(self.gcs.get_actor, spec.actor_id.binary())
+            if not info:
+                return False
+            owner = info["owner_node"]
+            self._actor_owner_cache[spec.actor_id] = owner
+        if owner == self.node_id:
+            return False
+        if getattr(spec, "_spill_count", 0) >= config.spillback_max_hops:
+            return False  # routing loop guard (stale owner metadata)
+        return self._forward_task(spec, owner)
 
     # --------------------------------------------------------------- scheduling
 
@@ -737,6 +1466,19 @@ class Raylet:
             spec = self._ready_queue.popleft()
             if self._dep_errored(spec):
                 continue
+            if spec.kind == ACTOR_TASK:
+                # An actor task can land in the ready queue via retry paths;
+                # route it through the actor machinery.
+                self._enqueue_ready(spec)
+                continue
+            placement = spec.placement or {}
+            if self.cluster_mode:
+                # Node affinity (reference: NodeAffinitySchedulingStrategy).
+                aff = placement.get("node_id")
+                if aff and aff != self.node_id:
+                    if not self._forward_task(spec, aff):
+                        deferred.append(spec)
+                    continue
             pool, need = self._task_resource_pools(spec)
             if pool is None:
                 # Distinguish "not schedulable yet" (pending PG, full
@@ -750,7 +1492,30 @@ class Raylet:
                 deferred.append(spec)
                 continue
             if not _fits(pool, need):
+                # Spillback (reference: ClusterTaskManager picks another
+                # node and the lease reply redirects the client,
+                # cluster_task_manager.cc:418): when the task cannot run
+                # here now but another node has capacity, forward it.
+                if (self.cluster_mode
+                        and not placement.get("pg")
+                        and getattr(spec, "_spill_count", 0)
+                        < config.spillback_max_hops):
+                    fits_total = _fits(self.resources_total, need)
+                    target = self._gcs_safe(
+                        self.gcs.place_task, need,
+                        exclude=[self.node_id])
+                    if target is None and not fits_total:
+                        # nowhere has capacity free now; if some node could
+                        # EVER fit it, forward there to queue
+                        feas = self._gcs_safe(self.gcs.feasible_nodes, need)
+                        feas = [n for n in (feas or []) if n != self.node_id]
+                        target = feas[0] if feas else None
+                    if target and self._forward_task(spec, target):
+                        continue
                 deferred.append(spec)
+                continue
+            if self._remote_deps_pending(spec):
+                deferred.append(spec)  # pulls in flight; retried on seal
                 continue
             profile = self._profile_key(spec)
             conn = self._get_idle_worker(profile)
@@ -810,17 +1575,51 @@ class Raylet:
                 arg_values[oid.hex()] = st.value
         fn_blob = None
         if spec.function_id is not None:
-            fn_blob = self._function_table.get(spec.function_id.binary())
+            key = spec.function_id.binary()
+            fn_blob = self._fn_cache.get(key)
+            if fn_blob is None:
+                fn_blob = self._gcs_safe(self.gcs.get_function, key)
+                if fn_blob is not None:
+                    self._fn_cache[key] = fn_blob
         self._record_event(spec, "RUNNING", pid=conn.pid)
         conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
                    "fn_blob": fn_blob})
 
     def _pump_actor(self, actor: _ActorState):
+        if actor.node_id is not None and actor.node_id != self.node_id:
+            # Remote-executing actor (owner side): relay calls to the exec
+            # node; it enforces max_concurrency and FIFO order (TCP keeps
+            # our send order).
+            if actor.state != "alive":
+                return
+            while actor.queue:
+                spec = actor.queue.popleft()
+                if self._dep_errored(spec):
+                    continue
+                if spec.method_name == "__ray_terminate__":
+                    actor.restarts_left = 0
+                self._record_event(spec, "FORWARDED", node=actor.node_id)
+                if not self._forward_task(spec, actor.node_id):
+                    actor.queue.appendleft(spec)
+                    return
+            return
         while (actor.state == "alive" and actor.conn is not None
                and actor.queue and len(actor.inflight) < actor.max_concurrency):
             spec = actor.queue.popleft()
             if self._dep_errored(spec):
                 continue
+            if self.cluster_mode and self._remote_deps_pending(spec):
+                # A store arg lives on another node: keep FIFO order, park
+                # the call until the pull seals it here (waiters fire only
+                # on local statuses; duplicates are harmless re-pumps).
+                actor.queue.appendleft(spec)
+                for oid in spec.dependency_ids():
+                    st = self._objects.get(oid)
+                    if (st is not None
+                            and st.status not in ("inline", "store", "error")):
+                        self._object_waiters.setdefault(oid, []).append(
+                            lambda _oid, a=actor: self._pump_actor(a))
+                break
             if spec.method_name == "__ray_terminate__":
                 # Graceful exit: the worker process will exit after replying;
                 # the EOF must not be treated as a crash worth restarting.
@@ -865,9 +1664,15 @@ class Raylet:
                 if spec.kind == ACTOR_TASK:
                     for oid in spec.return_ids():
                         self._object_error(oid, err)
-            # resubmit the creation task on a fresh worker
+            # resubmit the creation task on a fresh worker (possibly on a
+            # different node — the spill counter restarts with the attempt)
             creation = actor.creation_spec
             creation._acquired_pool = None
+            creation._spill_count = 0
+            actor.node_id = None
+            if self.cluster_mode and actor.foreign_owner is None:
+                self._gcs_post("update_actor", actor_id.binary(),
+                               "restarting")
             self._ready_queue.append(creation)
             actor.state = "pending"
             self._schedule()
@@ -890,17 +1695,57 @@ class Raylet:
             spec = actor.queue.popleft()
             for oid in spec.return_ids():
                 self._object_error(oid, err)
-        if actor.name:
-            self._named_actors = {
-                k: v for k, v in self._named_actors.items() if v != actor_id
-            }
+        if actor.foreign_owner is not None:
+            # exec side of a forwarded actor: the owner runs the restart
+            # state machine — report the death there.
+            peer = self._get_peer(actor.foreign_owner)
+            if peer is not None:
+                try:
+                    peer.send({"t": "xactor_death", "actor_id": actor_id,
+                               "reason": reason})
+                except OSError:
+                    self._drop_peer(peer)
+            del self._actors[actor_id]
+        elif actor.name or self.cluster_mode:
+            self._gcs_post("remove_actor", actor_id.binary())
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         actor = self._actors.get(actor_id)
         if actor is None:
+            if self.cluster_mode:
+                # Not ours: relay the kill to the owner.
+                owner = self._actor_owner_cache.get(actor_id)
+                if owner is None:
+                    info = self._gcs_safe(self.gcs.get_actor,
+                                          actor_id.binary())
+                    owner = info["owner_node"] if info else None
+                if owner and owner != self.node_id:
+                    peer = self._get_peer(owner)
+                    if peer is not None:
+                        try:
+                            peer.send({"t": "xkill", "actor_id": actor_id,
+                                       "no_restart": no_restart})
+                        except OSError:
+                            self._drop_peer(peer)
             return
         if no_restart:
             actor.restarts_left = 0
+        if actor.node_id is not None and actor.node_id != self.node_id:
+            # executing on a peer: kill there; death flows back as
+            # xactor_death
+            peer = self._get_peer(actor.node_id)
+            if peer is not None:
+                try:
+                    peer.send({"t": "xkill", "actor_id": actor_id,
+                               "no_restart": True})
+                    return
+                except OSError:
+                    self._drop_peer(peer)
+            # peer unreachable: treat as dead now
+            actor.node_id = None
+            self._on_actor_death(actor_id, "exec node unreachable",
+                                 allow_restart=not no_restart)
+            return
         conn = actor.conn
         if conn is not None and conn.pid:
             try:
@@ -908,6 +1753,28 @@ class Raylet:
             except OSError:
                 pass
         # death will be observed via socket EOF
+
+    def cancel_task(self, oid: ObjectID) -> bool:
+        """Best-effort cancel of a not-yet-running task (reference:
+        `CoreWorker::CancelTask`); running tasks are not interrupted."""
+        tid = oid.task_id()
+        entry = self._waiting.pop(tid, None)
+        found = entry is not None
+        if entry is not None:
+            spec, missing = entry
+            for m in missing:
+                peers = self._dep_index.get(m)
+                if peers:
+                    peers.discard(tid)
+        for spec in list(self._ready_queue):
+            if spec.task_id == tid:
+                self._ready_queue.remove(spec)
+                found = True
+        if found:
+            err = TaskError("cancelled", "task was cancelled before it ran",
+                            None)
+            self._object_error(oid, err)
+        return found
 
     # --------------------------------------------------------------- requests
 
@@ -948,43 +1815,72 @@ class Raylet:
                 self._object_inline(ObjectID.from_hex(msg["id"]), msg["blob"])
                 reply()
             elif op == "register_stored":
-                self._object_in_store(ObjectID.from_hex(msg["id"]))
+                oid = ObjectID.from_hex(msg["id"])
+                if "size" in msg:
+                    self._obj(oid).size = msg["size"]
+                self._object_in_store(oid)
                 reply()
             elif op == "kv_put":
-                self._kv[(msg["ns"], msg["key"])] = msg["val"]
+                self.gcs.kv_put(msg["ns"], msg["key"], msg["val"])
                 reply()
             elif op == "kv_get":
-                reply(value=self._kv.get((msg["ns"], msg["key"])))
+                reply(value=self.gcs.kv_get(msg["ns"], msg["key"]))
             elif op == "kv_del":
-                reply(value=self._kv.pop((msg["ns"], msg["key"]), None) is not None)
+                reply(value=self.gcs.kv_del(msg["ns"], msg["key"]))
             elif op == "kv_keys":
-                prefix = msg["prefix"]
-                reply(value=[k for (ns, k) in self._kv
-                             if ns == msg["ns"] and k.startswith(prefix)])
+                reply(value=self.gcs.kv_keys(msg["ns"], msg["prefix"]))
             elif op == "put_function":
-                self._function_table[msg["id"]] = msg["blob"]
+                self._fn_cache[msg["id"]] = msg["blob"]
+                self.gcs.put_function(msg["id"], msg["blob"])
                 reply()
             elif op == "get_function":
-                reply(value=self._function_table.get(msg["id"]))
+                blob = self._fn_cache.get(msg["id"])
+                if blob is None:
+                    blob = self.gcs.get_function(msg["id"])
+                reply(value=blob)
             elif op == "named_actor":
-                key = (msg.get("namespace", ""), msg["name"])
-                aid = self._named_actors.get(key)
-                if aid is None:
+                info = self.gcs.lookup_named_actor(
+                    msg.get("namespace", ""), msg["name"])
+                if info is None:
                     reply(ok=False, error=ValueError(
                         f"no actor named {msg['name']!r}"))
                 else:
-                    actor = self._actors[aid]
+                    import cloudpickle as _cp
+
+                    spec = (_cp.loads(info["spec_blob"])
+                            if info.get("spec_blob") else None)
+                    if spec is None:
+                        aid = ActorID(info["actor_id"])
+                        local = self._actors.get(aid)
+                        spec = local.creation_spec if local else None
                     reply(value={
-                        "actor_id": aid,
-                        "creation_spec": actor.creation_spec,
+                        "actor_id": ActorID(info["actor_id"]),
+                        "creation_spec": spec,
                     })
             elif op == "actor_state":
                 actor = self._actors.get(msg["actor_id"])
-                reply(value=None if actor is None else actor.state)
+                if actor is not None:
+                    reply(value=actor.state)
+                else:
+                    info = (self._gcs_safe(self.gcs.get_actor,
+                                           msg["actor_id"].binary())
+                            if self.cluster_mode else None)
+                    reply(value=info["state"] if info else None)
             elif op == "free":
                 for h in msg["ids"]:
                     self._objects.pop(ObjectID.from_hex(h), None)
+                    if self.cluster_mode:
+                        self._gcs_post("remove_object_location",
+                                       h, self.node_id)
                 reply()
+            elif op == "cancel_task":
+                reply(value=self.cancel_task(ObjectID.from_hex(msg["id"])))
+            elif op == "available_resources":
+                reply(value=dict(self.resources_available))
+            elif op == "cluster_resources":
+                reply(value=dict(self.resources_total))
+            elif op == "nodes":
+                reply(value=self.gcs.nodes())
             elif op == "cancel_request":
                 # The worker timed out and dropped its pending entry:
                 # deregister the waiters so they don't accumulate on the
@@ -1049,6 +1945,10 @@ class Raylet:
             elif status == "error":
                 results[oid.hex()] = ("error", st.error)
             else:
+                if self.cluster_mode:
+                    # sealed elsewhere (or unknown): fetch it here; the
+                    # waiter resolves on local seal
+                    self._maybe_pull(oid)
                 return False
             return True
 
@@ -1087,7 +1987,10 @@ class Raylet:
         pending: List[ObjectID] = []
 
         def is_ready(oid):
-            return self._object_status(oid) in ("inline", "store", "error")
+            status = self._object_status(oid)
+            if status == "remote" and self.cluster_mode:
+                self._maybe_pull(oid)  # fetch_local semantics
+            return status in ("inline", "store", "error")
 
         def cleanup():
             for oid in pending:
@@ -1268,12 +2171,18 @@ class Raylet:
     # --------------------------------------------------------------- shutdown
 
     def shutdown(self):
+        try:
+            self.gcs.unregister_node(self.node_id)
+        except Exception:  # noqa: BLE001
+            pass
         self._shutdown = True
         try:
             self._wake_w.send(b"\x00")
         except OSError:
             pass
         self._thread.join(timeout=5)
+        if isinstance(self.gcs, GcsClient):
+            self.gcs.close()
         for p in self._procs:
             try:
                 p.terminate()

@@ -96,15 +96,21 @@ class Worker:
 
     def register_function(self, callable_obj) -> Tuple[FunctionID, Optional[bytes]]:
         """Returns (function_id, inline_blob_or_None); large callables are
-        pushed to the raylet function table once (reference function_manager)."""
+        pushed to the GCS function table once (reference function_manager)."""
         blob = cloudpickle.dumps(callable_obj)
         fid = FunctionID(hashlib.sha1(blob).digest()[:16])
         if len(blob) <= config.inline_object_max_bytes:
             return fid, blob
         if fid not in self._pushed_functions:
-            self._request("put_function", id=fid.binary(), blob=blob)
+            self._push_function(fid, blob)
             self._pushed_functions.add(fid)
         return fid, None
+
+    def _push_function(self, fid: FunctionID, blob: bytes):
+        if self.mode == DRIVER:
+            self.raylet.gcs.put_function(fid.binary(), blob)
+        else:
+            self._request("put_function", id=fid.binary(), blob=blob)
 
     # ------------------------------------------------------------ core ops
 
@@ -129,9 +135,12 @@ class Worker:
         else:
             self.store.put_serialized(oid, ser)
             if self.mode == DRIVER:
-                self.raylet.call_async(self.raylet._object_in_store, oid)
+                def _mark(o=oid, n=size):
+                    self.raylet._obj(o).size = n
+                    self.raylet._object_in_store(o)
+                self.raylet.call_async(_mark)
             else:
-                self._request("register_stored", id=oid.hex())
+                self._request("register_stored", id=oid.hex(), size=size)
         return ObjectRef(oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
@@ -213,36 +222,43 @@ class Worker:
                 except Exception:  # noqa: BLE001
                     pass
 
-    # KV (GCS KV equivalent — backs runtime envs, Train/Tune metadata, Serve)
+    # KV (GCS KV — backs runtime envs, Train/Tune metadata, Serve).  The
+    # driver holds the GCS handle directly (embedded GcsCore or GcsClient);
+    # workers go through their raylet which proxies to the GCS.
     def kv_put(self, key: bytes, value: bytes, namespace: str = ""):
         if self.mode == DRIVER:
-            def _put():
-                self.raylet._kv[(namespace, key)] = value
-            self.raylet.call(_put).result()
+            self.raylet.gcs.kv_put(namespace, key, value)
         else:
             self._request("kv_put", ns=namespace, key=key, val=value)
 
     def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
         if self.mode == DRIVER:
-            return self.raylet.call(
-                lambda: self.raylet._kv.get((namespace, key))
-            ).result()
+            return self.raylet.gcs.kv_get(namespace, key)
         return self._request("kv_get", ns=namespace, key=key)
 
     def kv_del(self, key: bytes, namespace: str = ""):
         if self.mode == DRIVER:
-            return self.raylet.call(
-                lambda: self.raylet._kv.pop((namespace, key), None) is not None
-            ).result()
+            return self.raylet.gcs.kv_del(namespace, key)
         return self._request("kv_del", ns=namespace, key=key)
 
     def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
         if self.mode == DRIVER:
-            return self.raylet.call(
-                lambda: [k for (ns, k) in self.raylet._kv
-                         if ns == namespace and k.startswith(prefix)]
-            ).result()
+            return self.raylet.gcs.kv_keys(namespace, prefix)
         return self._request("kv_keys", ns=namespace, prefix=prefix)
+
+    def cancel(self, ref) -> bool:
+        if self.mode == DRIVER:
+            return self.raylet.call(self.raylet.cancel_task, ref.id()).result()
+        if self.mode == LOCAL:
+            return False
+        return self._request("cancel_task", id=ref.hex())
+
+    def gcs_nodes(self) -> List[dict]:
+        if self.mode == DRIVER:
+            return self.raylet.gcs.nodes()
+        if self.mode == LOCAL:
+            return []
+        return self._request("nodes")
 
     # ------------------------------------------------------------ worker mode
 

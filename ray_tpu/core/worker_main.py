@@ -143,14 +143,17 @@ def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
                 f"task {spec.name} declared num_returns={spec.num_returns} "
                 f"but returned {len(values)} values"
             )
+    sizes: Dict[str, int] = {}
     for oid, val in zip(spec.return_ids(), values):
         ser = serialization.serialize(val)
-        if ser.total_bytes() <= config.inline_object_max_bytes or worker.store is None:
+        n = ser.total_bytes()
+        if n <= config.inline_object_max_bytes or worker.store is None:
             inline[oid.hex()] = ser.to_bytes()
         else:
             worker.store.put_serialized(oid, ser)
             stored.append(oid.hex())
-    return inline, stored
+            sizes[oid.hex()] = n
+    return inline, stored, sizes
 
 
 def _apply_runtime_env(spec: TaskSpec):
@@ -191,9 +194,9 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
         result = await getattr(worker.actor_instance, spec.method_name)(
             *args, **kwargs
         )
-        inline, stored = _package_results(worker, spec, result)
+        inline, stored, sizes = _package_results(worker, spec, result)
         worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
-                      "inline": inline, "stored": stored})
+                      "inline": inline, "stored": stored, "sizes": sizes})
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
@@ -235,9 +238,9 @@ def execute_task(worker: RemoteWorker, msg: dict):
         else:
             fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
             result = fn(*args, **kwargs)
-        inline, stored = _package_results(worker, spec, result)
+        inline, stored, sizes = _package_results(worker, spec, result)
         worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
-                      "inline": inline, "stored": stored})
+                      "inline": inline, "stored": stored, "sizes": sizes})
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)

@@ -47,7 +47,18 @@ class EnvRunner:
             self._envs = gym.vector.SyncVectorEnv(
                 [env_creator for _ in range(num_envs)],
                 autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
-        except TypeError:  # older gymnasium: SAME_STEP was the default
+        except TypeError:
+            # No autoreset_mode kwarg.  Pre-1.0 gymnasium defaults to
+            # SAME_STEP so the fallback is safe there; 1.0.x defaults to
+            # NEXT_STEP but lacks the kwarg (AutoresetMode landed in 1.1),
+            # so silently proceeding would record post-termination garbage.
+            major, minor = (int(x) for x in gym.__version__.split(".")[:2])
+            if (major, minor) >= (1, 0):
+                raise RuntimeError(
+                    f"gymnasium {gym.__version__} defaults to NEXT_STEP "
+                    "autoreset but does not support requesting SAME_STEP "
+                    "(added in 1.1) — upgrade gymnasium to >= 1.1"
+                ) from None
             self._envs = gym.vector.SyncVectorEnv(
                 [env_creator for _ in range(num_envs)])
         self._num_envs = num_envs

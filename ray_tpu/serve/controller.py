@@ -294,13 +294,18 @@ class ServeController:
     def _autoscale(self):
         import ray_tpu
 
+        # Snapshot replica lists AND the state generation under the lock:
+        # deploy()/delete_deployment() run concurrently on other actor
+        # threads and clear/replace st.replicas; the EMA/target update
+        # below is skipped if the deployment changed underneath us.
         with self._lock:
-            states = list(self._deployments.items())
-        for name, st in states:
+            states = [(name, st, [r for r in st.replicas
+                                  if r.ready and not r.dead], st.version)
+                      for name, st in self._deployments.items()]
+        for name, st, ready, version in states:
             ac = st.spec.get("autoscaling_config")
             if not ac:
                 continue
-            ready = [r for r in st.replicas if r.ready and not r.dead]
             if not ready:
                 continue
             # probe in-flight counts (best effort, short timeout)
@@ -311,14 +316,17 @@ class ServeController:
                     total += ray_tpu.get(ref, timeout=1.0)
                 except Exception:  # noqa: BLE001
                     pass
-            alpha = ac.get("smoothing_factor", 0.6)
-            st.ongoing_ema = alpha * total + (1 - alpha) * st.ongoing_ema
-            target_per = ac.get("target_ongoing_requests", 1.0)
-            desired = math.ceil(st.ongoing_ema / max(target_per, 1e-9))
-            desired = max(ac.get("min_replicas", 1),
-                          min(ac.get("max_replicas", 1), desired))
             now = time.time()
             with self._lock:
+                if (self._deployments.get(name) is not st
+                        or st.version != version):
+                    continue  # redeployed/deleted mid-probe: stale sample
+                alpha = ac.get("smoothing_factor", 0.6)
+                st.ongoing_ema = alpha * total + (1 - alpha) * st.ongoing_ema
+                target_per = ac.get("target_ongoing_requests", 1.0)
+                desired = math.ceil(st.ongoing_ema / max(target_per, 1e-9))
+                desired = max(ac.get("min_replicas", 1),
+                              min(ac.get("max_replicas", 1), desired))
                 if desired > st.target:
                     st.under_since = None
                     if st.over_since is None:

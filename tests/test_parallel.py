@@ -72,6 +72,43 @@ def test_flash_attention_backward_matches_reference():
                 )
 
 
+@pytest.mark.parametrize("bq,bk", [(128, 128), (128, 256), (256, 128),
+                                   (256, 256)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_kernels_direct_multiblock(bq, bk, causal):
+    """Exercise _pallas_forward/_pallas_backward directly (interpret mode)
+    at S=256 with mixed block sizes — the production-shaped multi-block
+    causal split (first_diag/diag_end two-phase fori loops) that the
+    _use_pallas gate keeps out of the public-API path on CPU."""
+    from ray_tpu.ops.flash_attention import (
+        _pallas_backward,
+        _pallas_forward,
+    )
+
+    B, H, S, D = 1, 2, 256, 32
+    q, k, v = _qkv(B, H, S, D)
+    scale = D ** -0.5
+
+    o, lse = _pallas_forward(q, k, v, scale, causal, bq, bk, interpret=True)
+    ref_o, ref_lse = _reference_attention(q, k, v, scale, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o), atol=TOL)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=TOL)
+
+    def loss_ref(q, k, v):
+        o, _ = _reference_attention(q, k, v, scale, causal)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    do = (2.0 * o).astype(q.dtype)  # d/do of sum(o^2)
+    dq, dk, dv = _pallas_backward(q, k, v, o, lse, do, scale, causal,
+                                  bq, bk, interpret=True)
+    for a, b, name in zip((dq, dk, dv), gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-2,
+            err_msg=f"{name} causal={causal} bq={bq} bk={bk}")
+
+
 def test_ring_attention_matches_dense():
     B, H, S, D = 2, 4, 128, 32
     q, k, v = _qkv(B, H, S, D)
