@@ -1,0 +1,156 @@
+"""Core-runtime microbenchmark — the `ray_perf.py` analogue
+(reference: `python/ray/_private/ray_perf.py:93`, recorded numbers in
+`release/release_logs/2.5.0/microbenchmark.json`, tabulated in BASELINE.md).
+
+Prints one JSON line per metric and writes the full dict to
+``BENCH_CORE.json``.  Run: ``python bench_core.py [--quick]``.
+
+Reference single-client numbers to beat (m4.16xlarge-class):
+  plasma put/get        6,364 / 5,980 ops/s
+  put throughput        18.8 GiB/s
+  tasks sync            1,341 /s
+  tasks async           11,527 /s
+  actor calls sync 1:1  2,427 /s
+  actor calls async 1:1 8,178 /s
+  pg create/remove      1,089 /s
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+# CPU-only: the control plane is what's being measured, keep jax/TPU out
+# of the workers entirely.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np  # noqa: E402
+
+
+def timed(n, fn):
+    t0 = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="10x fewer iterations (CI smoke)")
+    args = parser.parse_args()
+    scale = 0.1 if args.quick else 1.0
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+    results = {}
+
+    def record(name, value, unit="ops/s", baseline=None):
+        results[name] = {"value": round(value, 1), "unit": unit}
+        if baseline:
+            results[name]["vs_reference"] = round(value / baseline, 2)
+        print(json.dumps({"metric": name, **results[name]}), flush=True)
+
+    # ---- object store put/get (small objects: op overhead) ----
+    n = int(3000 * scale)
+    small = np.zeros(16, np.uint8)
+
+    def put_loop():
+        for _ in range(n):
+            ray_tpu.put(small)
+
+    record("put_small_ops_per_s", timed(n, put_loop), baseline=6364.1)
+
+    big_ref = ray_tpu.put(np.zeros(1 << 20, np.uint8))  # 1MB -> store
+
+    def get_loop():
+        for _ in range(n):
+            ray_tpu.get(big_ref)
+
+    record("get_1mb_ops_per_s", timed(n, get_loop), baseline=5979.7)
+
+    # ---- put throughput (GiB/s, 64MB objects, steady state) ----
+    blob = np.random.randint(0, 255, 64 << 20, np.uint8)
+    reps = max(2, int(16 * scale))
+    ray_tpu.free([ray_tpu.put(blob)])  # warm pages/allocator
+
+    def put_tp():
+        for _ in range(reps):
+            ray_tpu.free([ray_tpu.put(blob)])
+
+    gib = reps * blob.nbytes / (1 << 30)
+    t0 = time.perf_counter()
+    put_tp()
+    record("put_gib_per_s", gib / (time.perf_counter() - t0), unit="GiB/s",
+           baseline=18.8)
+
+    # ---- tasks ----
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    # warm the worker pool so spawn cost isn't measured
+    ray_tpu.get([nop.remote() for _ in range(8)])
+
+    n = int(1000 * scale)
+
+    def tasks_sync():
+        for _ in range(n):
+            ray_tpu.get(nop.remote())
+
+    record("tasks_sync_per_s", timed(n, tasks_sync), baseline=1341.4)
+
+    n = int(10000 * scale)
+
+    def tasks_async():
+        ray_tpu.get([nop.remote() for _ in range(n)])
+
+    record("tasks_async_per_s", timed(n, tasks_async), baseline=11527.5)
+
+    # ---- actor calls ----
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+
+    n = int(2000 * scale)
+
+    def actor_sync():
+        for _ in range(n):
+            ray_tpu.get(a.m.remote())
+
+    record("actor_calls_sync_per_s", timed(n, actor_sync), baseline=2427.0)
+
+    n = int(10000 * scale)
+
+    def actor_async():
+        ray_tpu.get([a.m.remote() for _ in range(n)])
+
+    record("actor_calls_async_per_s", timed(n, actor_async), baseline=8177.9)
+
+    # ---- placement groups ----
+    n = int(500 * scale)
+
+    def pgs():
+        for _ in range(n):
+            pg = ray_tpu.placement_group([{"CPU": 1}])
+            pg.wait(timeout_seconds=10)
+            ray_tpu.remove_placement_group(pg)
+
+    record("pg_create_remove_per_s", timed(n, pgs), baseline=1088.5)
+
+    ray_tpu.shutdown()
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CORE.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

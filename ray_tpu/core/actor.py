@@ -57,17 +57,27 @@ class ActorHandle:
     def _invoke(self, method_name, args, kwargs, opts):
         worker = global_worker()
         out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if streaming:
+            from ray_tpu.core.task_spec import STREAMING_RETURNS
+
+            num_returns = STREAMING_RETURNS
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             kind=ACTOR_TASK,
             name=f"{self._class_name}.{method_name}",
             args=out_args,
             kwargs=out_kwargs,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=num_returns,
             actor_id=self._actor_id,
             method_name=method_name,
         )
         refs = worker.submit_spec(spec)
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         return refs[0] if spec.num_returns == 1 else refs
 
     def __getattr__(self, name):

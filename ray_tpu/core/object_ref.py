@@ -65,3 +65,51 @@ class ObjectRef:
 
     def __reduce__(self):
         return (ObjectRef, (self._id,))
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yields (reference:
+    ``ObjectRefGenerator``, `python/ray/_raylet.pyx:209`): each ``next()``
+    blocks until the producer has yielded item *i* (it can be consumed
+    while the task is still running), then returns the item's ObjectRef.
+    """
+
+    def __init__(self, task_id):
+        self._task_id = task_id
+        self._index = 0
+        self._done = False
+
+    @property
+    def task_id(self):
+        return self._task_id
+
+    def completed(self) -> "ObjectRef":
+        """Ref that resolves (to the item count) when the stream finishes."""
+        from ray_tpu.core.ids import ObjectID
+
+        return ObjectRef(ObjectID.for_task_return(self._task_id, 0))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._done:
+            raise StopIteration
+        from ray_tpu.core import worker as _w
+        from ray_tpu.core.ids import ObjectID
+
+        res = _w.global_worker().stream_next(self._task_id, self._index)
+        kind = res["kind"]
+        if kind == "end":
+            self._done = True
+            raise StopIteration
+        if kind == "error":
+            self._done = True
+            raise res["error"]
+        ref = ObjectRef(
+            ObjectID.for_task_return(self._task_id, self._index + 1))
+        self._index += 1
+        return ref
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()}@{self._index})"

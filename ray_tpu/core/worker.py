@@ -246,6 +246,31 @@ class Worker:
             return self.raylet.gcs.kv_keys(namespace, prefix)
         return self._request("kv_keys", ns=namespace, prefix=prefix)
 
+    def stream_next(self, task_id, index: int,
+                    timeout: Optional[float] = None) -> dict:
+        """Block until item ``index`` of a streaming task exists (or the
+        stream ended/errored).  Returns {"kind": "item"|"end"|"error",...}."""
+        if self.mode == DRIVER:
+            from ray_tpu.core.raylet import SimpleFuture
+
+            fut = SimpleFuture()
+            cancel_fut = self.raylet.call(
+                self.raylet.async_stream_next, task_id, index, fut.set)
+            try:
+                return fut.result(timeout)
+            except TimeoutError:
+                def _cancel():
+                    try:
+                        cancel = cancel_fut.result(0)
+                    except Exception:  # noqa: BLE001
+                        return
+                    if cancel is not None:
+                        cancel()
+                self.raylet.call_async(_cancel)
+                raise
+        return self._request("stream_next", task_id=task_id, index=index,
+                             _wait_timeout=timeout)
+
     def cancel(self, ref) -> bool:
         if self.mode == DRIVER:
             return self.raylet.call(self.raylet.cancel_task, ref.id()).result()
@@ -381,10 +406,22 @@ class LocalWorker(Worker):
         super().__init__(LOCAL)
         self._objects: Dict[ObjectID, Tuple[str, Any]] = {}
         self._actors: Dict[Any, Any] = {}
+        self._local_streams: Dict[Any, int] = {}
         self.store = InProcObjectStore()
 
+    def stream_next(self, task_id, index, timeout=None):
+        total = self._local_streams.get(task_id)
+        if total is None:
+            return {"kind": "error",
+                    "error": ValueError(f"unknown stream {task_id.hex()}")}
+        return {"kind": "item"} if index < total else {"kind": "end"}
+
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
-        from ray_tpu.core.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK
+        from ray_tpu.core.task_spec import (
+            ACTOR_CREATION_TASK,
+            ACTOR_TASK,
+            STREAMING_RETURNS,
+        )
 
         fn = (cloudpickle.loads(spec.function_blob)
               if spec.function_blob is not None else None)
@@ -400,7 +437,13 @@ class LocalWorker(Worker):
                 result = getattr(inst, spec.method_name)(*args, **kwargs)
             else:
                 result = fn(*args, **kwargs)
-            if spec.num_returns == 1:
+            if spec.num_returns == STREAMING_RETURNS:
+                items = list(result)  # local mode: drain eagerly
+                for i, v in enumerate(items):
+                    self._objects[spec.stream_item_id(i)] = ("v", v)
+                self._local_streams[spec.task_id] = len(items)
+                self._objects[refs[0].id()] = ("v", len(items))
+            elif spec.num_returns == 1:
                 self._objects[refs[0].id()] = ("v", result)
             else:
                 for r, v in zip(refs, result):

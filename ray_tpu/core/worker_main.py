@@ -32,6 +32,7 @@ from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.task_spec import (
     ACTOR_CREATION_TASK,
     ACTOR_TASK,
+    STREAMING_RETURNS,
     TaskSpec,
 )
 from ray_tpu.core.worker import WORKER, Worker, init_worker
@@ -134,8 +135,8 @@ def _resolve_args(worker: RemoteWorker, spec: TaskSpec, arg_values):
 def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
     inline: Dict[str, bytes] = {}
     stored = []
-    if spec.num_returns == 1:
-        values = [result]
+    if spec.num_returns in (1, STREAMING_RETURNS):
+        values = [result]  # streaming: result is the completion marker
     else:
         values = list(result)
         if len(values) != spec.num_returns:
@@ -154,6 +155,27 @@ def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
             stored.append(oid.hex())
             sizes[oid.hex()] = n
     return inline, stored, sizes
+
+
+def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
+    """Drive a generator task: each yield ships to the raylet immediately
+    (reference: streaming generator returns, `_raylet.pyx:224`) so consumers
+    can read item i while item i+1 is still being produced.  The slot-0
+    completion marker resolves to the item count."""
+    idx = 0
+    for item in gen:
+        oid = spec.stream_item_id(idx)
+        ser = serialization.serialize(item)
+        n = ser.total_bytes()
+        if n <= config.inline_object_max_bytes or worker.store is None:
+            worker._send({"t": "stream_item", "id": oid.hex(), "index": idx,
+                          "inline": ser.to_bytes()})
+        else:
+            worker.store.put_serialized(oid, ser)
+            worker._send({"t": "stream_item", "id": oid.hex(), "index": idx,
+                          "inline": None, "size": n})
+        idx += 1
+    return idx
 
 
 def _apply_runtime_env(spec: TaskSpec):
@@ -238,6 +260,8 @@ def execute_task(worker: RemoteWorker, msg: dict):
         else:
             fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
             result = fn(*args, **kwargs)
+        if spec.num_returns == STREAMING_RETURNS:
+            result = _run_streaming(worker, spec, result)
         inline, stored, sizes = _package_results(worker, spec, result)
         worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
                       "inline": inline, "stored": stored, "sizes": sizes})
@@ -250,11 +274,47 @@ def execute_task(worker: RemoteWorker, msg: dict):
         })
 
 
+class _PrefixStream:
+    """Line-prefixing stdout/stderr wrapper — the lightweight analogue of
+    the reference's log monitor pipeline (worker log files tailed by
+    `log_monitor.py:102` and re-printed on the driver with a
+    ``(pid=..)`` prefix).  Workers inherit the driver's stdio here, so
+    prefixing at the source gives the same attribution."""
+
+    def __init__(self, stream, prefix: str):
+        self._stream = stream
+        self._prefix = prefix
+        self._at_line_start = True
+
+    def write(self, data: str):
+        if not data:
+            return 0
+        out = []
+        for chunk in data.splitlines(keepends=True):
+            if self._at_line_start:
+                out.append(self._prefix)
+            out.append(chunk)
+            self._at_line_start = chunk.endswith("\n")
+        self._stream.write("".join(out))
+        return len(data)
+
+    def flush(self):
+        self._stream.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--socket", required=True)
     parser.add_argument("--store", default=None)
     args = parser.parse_args()
+
+    if config.log_to_driver:
+        prefix = f"(worker pid={os.getpid()}) "
+        sys.stdout = _PrefixStream(sys.stdout, prefix)
+        sys.stderr = _PrefixStream(sys.stderr, prefix)
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(args.socket)

@@ -1,0 +1,152 @@
+"""``ray_tpu`` CLI — cluster lifecycle + state inspection.
+
+Reference analogue: `python/ray/scripts/scripts.py` (``ray start`` `:540`,
+``ray stop`` `:1004`, ``ray status``).  argparse instead of click (no extra
+dependency); run as ``python -m ray_tpu.scripts <command>``.
+
+Commands:
+  start --head [--port P] [--resources JSON]   start GCS + a raylet here
+  start --address HOST:PORT [--resources JSON] join an existing cluster
+  stop                                         stop local ray_tpu processes
+  status --address HOST:PORT                   cluster resource summary
+  list {nodes,actors,tasks} --address ...      state tables
+  timeline --address ... --out FILE            chrome://tracing dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_PID_DIR = "/tmp/ray_tpu/pids"
+
+
+def _save_pid(kind: str, pid: int):
+    os.makedirs(_PID_DIR, exist_ok=True)
+    with open(os.path.join(_PID_DIR, f"{kind}_{pid}.pid"), "w") as f:
+        f.write(str(pid))
+
+
+def cmd_start(args) -> int:
+    resources = args.resources or "{}"
+    if args.head:
+        gcs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.gcs_main",
+             "--port", str(args.port)],
+            stdout=subprocess.PIPE, text=True)
+        line = gcs.stdout.readline().strip()
+        address = line.split()[1]
+        _save_pid("gcs", gcs.pid)
+        print(f"GCS started at {address}")
+    else:
+        if not args.address:
+            print("error: --address required without --head",
+                  file=sys.stderr)
+            return 2
+        address = args.address
+    raylet = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.raylet_main",
+         "--gcs", address, "--resources", resources],
+        stdout=subprocess.PIPE, text=True)
+    line = raylet.stdout.readline().strip()
+    _save_pid("raylet", raylet.pid)
+    print(f"raylet started: {line}")
+    print(f"\nconnect with: ray_tpu.init(address=\"{address}\")")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    stopped = 0
+    if os.path.isdir(_PID_DIR):
+        for name in os.listdir(_PID_DIR):
+            path = os.path.join(_PID_DIR, name)
+            try:
+                with open(path) as f:
+                    pid = int(f.read().strip())
+                os.kill(pid, signal.SIGTERM)
+                stopped += 1
+            except (OSError, ValueError):
+                pass
+            os.unlink(path)
+    print(f"stopped {stopped} process(es)")
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _connect(args)
+    nodes = ray_tpu.nodes()
+    alive = [n for n in nodes if n["Alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    total = ray_tpu.cluster_resources()
+    print("resources:", json.dumps(total))
+    for n in nodes:
+        mark = "+" if n["Alive"] else "-"
+        print(f"  {mark} {n['NodeID'][:12]} {n.get('Hostname','')} "
+              f"{json.dumps(n['Resources'])}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _connect(args)
+    from ray_tpu.util import state
+
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "tasks": state.list_tasks}[args.what]
+    for row in fn():
+        print(json.dumps(row, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    ray_tpu = _connect(args)
+    events = ray_tpu.timeline(args.out)
+    print(f"wrote {len(events)} events to {args.out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start cluster processes on this host")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--address", default=None, help="GCS host:port to join")
+    p.add_argument("--resources", default=None, help='JSON, e.g. {"CPU":8}')
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop processes started here")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster resource summary")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="state tables")
+    p.add_argument("what", choices=["nodes", "actors", "tasks"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline", help="chrome://tracing dump")
+    p.add_argument("--address", required=True)
+    p.add_argument("--out", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

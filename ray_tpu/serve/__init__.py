@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 
+from ray_tpu.serve.batching import batch, batch_sizes_of
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE, ServeController
 from ray_tpu.serve.http_proxy import PROXY_NAME, HTTPProxy
 from ray_tpu.serve.router import DeploymentHandle
@@ -28,7 +29,7 @@ from ray_tpu.serve.router import DeploymentHandle
 __all__ = [
     "deployment", "run", "start", "shutdown", "delete", "status",
     "get_deployment_handle", "get_app_handle", "Deployment", "Application",
-    "AutoscalingConfig", "DeploymentHandle",
+    "AutoscalingConfig", "DeploymentHandle", "batch", "batch_sizes_of",
 ]
 
 _state_lock = threading.Lock()
@@ -143,7 +144,8 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
             ray_tpu.init()
         ctrl_cls = ray_tpu.remote(
             num_cpus=0.1, name=CONTROLLER_NAME, namespace=NAMESPACE,
-            max_concurrency=16,
+            # long-poll listeners each hold a concurrency slot while parked
+            max_concurrency=64,
         )(ServeController)
         ctrl = ctrl_cls.remote()
         if with_proxy:

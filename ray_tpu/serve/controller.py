@@ -38,6 +38,11 @@ class _ReplicaState:
         self.ready_ref = None
         self.health_ref = None  # outstanding liveness probe
         self.dead = False
+        # rolling redeploy: old-version replicas keep serving until the new
+        # ones are ready, then drain (killed once idle or after timeout)
+        self.draining = False
+        self.drain_since = None
+        self.drain_probe = None
 
 
 class _DeploymentState:
@@ -60,6 +65,9 @@ class _DeploymentState:
 class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
+        # long-poll listeners wake on every routing-version bump
+        # (reference: LongPollHost, `serve/_private/long_poll.py:187`)
+        self._change = threading.Condition(self._lock)
         self._deployments: Dict[str, _DeploymentState] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment name
         self._version = 0
@@ -79,12 +87,18 @@ class ServeController:
                 name = spec["name"]
                 existing = self._deployments.get(name)
                 if existing is not None:
-                    # In-place update: new code/config, replace replicas.
+                    # In-place ROLLING update (reference: deployment_state
+                    # rolling replica replacement): old replicas keep
+                    # serving until new-version replicas are ready, then
+                    # drain — requests never hit a just-killed replica and
+                    # there is no empty-replica window.
                     existing.spec = spec
                     existing.target = self._initial_target(spec)
+                    now = time.time()
                     for r in existing.replicas:
-                        self._kill_replica(r)
-                    existing.replicas = []
+                        if not r.draining:
+                            r.draining = True
+                            r.drain_since = now
                     existing.version += 1
                 else:
                     st = _DeploymentState(spec)
@@ -93,6 +107,7 @@ class ServeController:
                 if spec.get("route_prefix"):
                     self._routes[spec["route_prefix"]] = name
             self._version += 1
+            self._change.notify_all()
         self._reconcile()
         return True
 
@@ -113,6 +128,7 @@ class ServeController:
             self._routes = {p: d for p, d in self._routes.items()
                             if d != name}
             self._version += 1
+            self._change.notify_all()
         return True
 
     def shutdown(self):
@@ -135,8 +151,7 @@ class ServeController:
                 "version": self._version,
                 "deployments": {
                     name: {
-                        "replicas": [r.name for r in st.replicas
-                                     if r.ready and not r.dead],
+                        "replicas": self._serving_replica_names(st),
                         "max_ongoing_requests":
                             st.spec.get("max_ongoing_requests", 100),
                     }
@@ -144,6 +159,35 @@ class ServeController:
                 },
                 "routes": dict(self._routes),
             }
+
+    def listen_for_change(self, known_version: int,
+                          timeout: float = 30.0) -> dict:
+        """Long-poll (reference: `serve/_private/long_poll.py:187`): block
+        until the routing version moves past ``known_version`` (or the
+        idle timeout lapses — the client just re-issues), then return the
+        fresh routing table.  Handles learn of redeploys the instant they
+        land instead of on a poll interval."""
+        deadline = time.time() + timeout
+        with self._change:
+            while self._version == known_version and not self._shutdown:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._change.wait(remaining)
+        return self.get_routing()
+
+    def _serving_replica_names(self, st) -> list:
+        # Blue/green flip: traffic moves to the new version only when its
+        # FULL replica set is ready — a partial flip would funnel all
+        # traffic through the first fresh replica while the rest start.
+        fresh = [r.name for r in st.replicas
+                 if r.ready and not r.dead and not r.draining]
+        if len(fresh) >= st.target:
+            return fresh
+        # mid-rollout: the old version serves until the new one is up
+        old = [r.name for r in st.replicas
+               if r.ready and not r.dead and r.draining]
+        return old if old else fresh
 
     def status(self) -> dict:
         with self._lock:
@@ -169,8 +213,8 @@ class ServeController:
                         f"deployment {name!r} unhealthy: "
                         f"{st.unhealthy_reason}")
                 if st is not None and st.target >= 1 and \
-                        sum(1 for r in st.replicas
-                            if r.ready and not r.dead) >= st.target:
+                        sum(1 for r in st.replicas if r.ready
+                            and not r.dead and not r.draining) >= st.target:
                     return True
             time.sleep(0.05)
         return False
@@ -241,23 +285,29 @@ class ServeController:
                                     f"replica failed to start "
                                     f"{st.start_failures}x: {e!r}")
                             self._version += 1
+                            self._change.notify_all()
                             continue
                         r.ready = True
                         r.ready_ref = None
                         st.start_failures = 0
                         st.unhealthy_reason = None
                         self._version += 1
+                        self._change.notify_all()
                 # reap ready replicas that died after startup (health probe
                 # issued by _health_check; a dead actor errors its calls)
                 for r in list(st.replicas):
                     if r.ready and getattr(r, "dead", False):
                         st.replicas.remove(r)
                         self._version += 1
-                # scale up
+                        self._change.notify_all()
+                # drain old-version replicas once the new version serves
+                self._reap_draining(st)
+                # scale up (draining replicas don't count toward target)
                 spec = st.spec
                 if st.unhealthy_reason is not None:
                     continue
-                while len(st.replicas) < st.target:
+                active = [r for r in st.replicas if not r.draining]
+                while len(active) < st.target:
                     uid = st.next_uid
                     st.next_uid += 1
                     actor_name = replica_actor_name(name, uid)
@@ -277,11 +327,52 @@ class ServeController:
                     r = _ReplicaState(actor_name, handle, uid)
                     r.ready_ref = handle.check_health.remote()
                     st.replicas.append(r)
+                    active.append(r)
                 # scale down (newest-first, reference removes most recent)
-                while len(st.replicas) > st.target:
-                    victim = st.replicas.pop()
+                while len(active) > st.target:
+                    victim = active.pop()
+                    st.replicas.remove(victim)
                     self._kill_replica(victim)
                     self._version += 1
+                    self._change.notify_all()
+
+    def _reap_draining(self, st: "_DeploymentState"):
+        """Kill draining replicas once (a) the new version is serving and
+        (b) they are idle (queue probe == 0) or the drain grace expired.
+        Runs under the controller lock."""
+        import ray_tpu
+
+        draining = [r for r in st.replicas if r.draining]
+        if not draining:
+            return
+        fresh_ready = sum(1 for r in st.replicas
+                          if r.ready and not r.dead and not r.draining)
+        if fresh_ready < st.target and st.target > 0:
+            return  # old version still carries the traffic
+        now = time.time()
+        for r in draining:
+            idle = False
+            if r.dead:
+                idle = True
+            elif now - (r.drain_since or now) > 10.0:
+                idle = True  # grace expired: force
+            else:
+                if r.drain_probe is None:
+                    r.drain_probe = r.handle.get_queue_len.remote()
+                else:
+                    done, _ = ray_tpu.wait([r.drain_probe], num_returns=1,
+                                           timeout=0)
+                    if done:
+                        try:
+                            idle = ray_tpu.get(r.drain_probe, timeout=1) == 0
+                        except Exception:  # noqa: BLE001
+                            idle = True  # already dead
+                        r.drain_probe = None
+            if idle:
+                st.replicas.remove(r)
+                self._kill_replica(r)
+                self._version += 1
+                self._change.notify_all()
 
     def _kill_replica(self, r: _ReplicaState):
         import ray_tpu
@@ -299,8 +390,9 @@ class ServeController:
         # threads and clear/replace st.replicas; the EMA/target update
         # below is skipped if the deployment changed underneath us.
         with self._lock:
-            states = [(name, st, [r for r in st.replicas
-                                  if r.ready and not r.dead], st.version)
+            states = [(name, st, [r for r in st.replicas if r.ready
+                                  and not r.dead and not r.draining],
+                       st.version)
                       for name, st in self._deployments.items()]
         for name, st, ready, version in states:
             ac = st.spec.get("autoscaling_config")

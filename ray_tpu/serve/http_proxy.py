@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 PROXY_NAME = "SERVE_PROXY"
+_SENTINEL = object()
 
 
 class HTTPProxy:
@@ -40,6 +41,11 @@ class HTTPProxy:
                 pass
 
             def _dispatch(self, body: Optional[bytes]):
+                from urllib.parse import parse_qs
+
+                query = (self.path.split("?", 1) + [""])[1]
+                if parse_qs(query).get("stream", ["0"])[0] == "1":
+                    return self._dispatch_stream(body)
                 try:
                     status, payload = proxy._handle(self.path, body)
                 except Exception as e:  # noqa: BLE001
@@ -50,6 +56,39 @@ class HTTPProxy:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _dispatch_stream(self, body: Optional[bytes]):
+                """?stream=1: chunked NDJSON, one line per yielded item —
+                items flush as the replica produces them (streaming
+                generator returns underneath)."""
+                try:
+                    items = proxy._handle_stream(self.path, body)
+                    first = next(items, _SENTINEL)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                    self.wfile.flush()
+
+                try:
+                    if first is not _SENTINEL:
+                        chunk(json.dumps(first, default=str).encode() + b"\n")
+                        for item in items:
+                            chunk(json.dumps(item, default=str).encode()
+                                  + b"\n")
+                except Exception as e:  # noqa: BLE001 mid-stream failure
+                    chunk(json.dumps({"error": str(e)}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
 
             def do_GET(self):
                 self._dispatch(None)
@@ -75,13 +114,7 @@ class HTTPProxy:
         if path == "/-/routes":
             with self._lock:
                 return 200, json.dumps(self._routes).encode()
-        with self._lock:
-            match = None
-            for prefix, deployment in self._routes.items():
-                if path == prefix or path.startswith(
-                        prefix.rstrip("/") + "/") or prefix == "/":
-                    if match is None or len(prefix) > len(match[0]):
-                        match = (prefix, deployment)
+        match = self._match_route(path)
         if match is None:
             return 404, json.dumps({"error": f"no route for {path}"}).encode()
         deployment = match[1]
@@ -90,13 +123,38 @@ class HTTPProxy:
         result = ray_tpu.get(handle.remote(request), timeout=120)
         return 200, json.dumps(result, default=str).encode()
 
-    def _get_handle(self, deployment: str):
+    def _match_route(self, path: str):
+        path = path.split("?", 1)[0]
+        with self._lock:
+            match = None
+            for prefix, deployment in self._routes.items():
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    if match is None or len(prefix) > len(match[0]):
+                        match = (prefix, deployment)
+        return match
+
+    def _handle_stream(self, path: str, body: Optional[bytes]):
+        """Yield the deployment's streamed items (resolved values)."""
+        import ray_tpu
+
+        match = self._match_route(path)
+        if match is None:
+            raise ValueError(f"no route for {path}")
+        handle = self._get_handle(match[1], stream=True)
+        request = json.loads(body) if body else None
+        for ref in handle.remote(request):
+            yield ray_tpu.get(ref, timeout=120)
+
+    def _get_handle(self, deployment: str, stream: bool = False):
         from ray_tpu.serve.router import DeploymentHandle
 
+        key = (deployment, stream)
         with self._lock:
-            h = self._handles.get(deployment)
+            h = self._handles.get(key)
             if h is None:
-                h = self._handles[deployment] = DeploymentHandle(deployment)
+                h = self._handles[key] = DeploymentHandle(
+                    deployment, stream=stream)
             return h
 
     # ---------------------------------------------------------------- ctrl

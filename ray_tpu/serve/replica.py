@@ -47,6 +47,25 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_stream(self, request: Any, method: str = "__call__"):
+        """Generator variant (invoked with num_returns="streaming"): the
+        user callable returns an iterator whose items stream to the caller
+        as they are produced (reference: Serve streaming responses over
+        streaming generator returns)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method == "__call__" and callable(self._callable):
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method)
+            for item in fn(request):
+                yield item
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     # ------------------------------------------------------------- control
 
     def get_queue_len(self) -> int:

@@ -3,9 +3,10 @@
 Reference analogues: `python/ray/serve/handle.py:86` (``RayServeHandle``),
 `serve/_private/router.py:244` (``PowerOfTwoChoicesReplicaScheduler``:
 sample two replicas, probe queue lengths, pick the shorter queue —
-`:639,856`).  Config push is poll-based here (the reference long-polls,
-`_private/long_poll.py`): handles refresh their replica set from the
-controller when stale or on miss.
+`:639,856`).  Config PUSH: a background listener long-polls the controller
+(`listen_for_change`, the `_private/long_poll.py:187` analogue), so a
+redeploy updates every handle the moment the routing version bumps — no
+staleness window.
 """
 
 from __future__ import annotations
@@ -17,54 +18,121 @@ from typing import Any, List, Optional
 
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE
 
-_REFRESH_S = 1.0
 
+class _DeploymentRouting:
+    """Process-wide routing cache for ONE deployment, fed by a single
+    long-poll listener thread — every DeploymentHandle (and every
+    ``.options()`` copy) shares it, so N handles cost one parked
+    ``listen_for_change`` call on the controller, not N."""
 
-class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
-        self._deployment = deployment_name
-        self._method = method_name
-        self._lock = threading.Lock()
-        self._replicas: List[Any] = []  # ActorHandles
-        self._fetched_at = 0.0
-        self._version = -1
-
-    # ------------------------------------------------------------- plumbing
+    def __init__(self, deployment: str):
+        self.deployment = deployment
+        self.lock = threading.Lock()
+        self.replicas: List[Any] = []
+        self.fetched = False
+        self.version = -1
+        self._listener: Optional[threading.Thread] = None
 
     def _controller(self):
         import ray_tpu
 
         return ray_tpu.get_actor(CONTROLLER_NAME, namespace=NAMESPACE)
 
-    def _refresh(self, force: bool = False):
+    def apply(self, routing: dict):
         import ray_tpu
 
-        now = time.time()
-        with self._lock:
-            if not force and self._replicas and \
-                    now - self._fetched_at < _REFRESH_S:
-                return
-        routing = ray_tpu.get(self._controller().get_routing.remote(),
-                              timeout=10)
-        entry = routing["deployments"].get(self._deployment)
+        entry = routing["deployments"].get(self.deployment)
         if entry is None:
-            raise ValueError(
-                f"no deployment named {self._deployment!r}")
+            raise ValueError(f"no deployment named {self.deployment!r}")
         handles = [ray_tpu.get_actor(n, namespace=NAMESPACE)
                    for n in entry["replicas"]]
-        with self._lock:
-            self._replicas = handles
-            self._fetched_at = now
-            self._version = routing["version"]
+        with self.lock:
+            self.replicas = handles
+            self.fetched = True
+            self.version = routing["version"]
+
+    def refresh(self, force: bool = False):
+        import ray_tpu
+
+        with self.lock:
+            if not force and self.fetched:
+                return
+        self.apply(
+            ray_tpu.get(self._controller().get_routing.remote(), timeout=10))
+        self.ensure_listener()
+
+    def ensure_listener(self):
+        with self.lock:
+            if self._listener is not None and self._listener.is_alive():
+                return
+            self._listener = threading.Thread(
+                target=self._listen_loop, name=f"serve-lp-{self.deployment}",
+                daemon=True)
+            self._listener.start()
+
+    def _listen_loop(self):
+        """Push channel: parked on the controller until the routing version
+        moves; an idle timeout just re-issues the poll."""
+        import ray_tpu
+
+        while True:
+            try:
+                routing = ray_tpu.get(
+                    self._controller().listen_for_change.remote(
+                        self.version, 30.0),
+                    timeout=45)
+                if routing["deployments"].get(self.deployment) is None:
+                    with _routing_lock:
+                        _routing.pop(self.deployment, None)
+                    return  # deployment deleted: stop listening
+                self.apply(routing)
+            except Exception:  # noqa: BLE001 controller restart/teardown
+                time.sleep(0.2)
+                try:
+                    self._controller()
+                except Exception:  # noqa: BLE001 serve is gone
+                    with _routing_lock:
+                        _routing.pop(self.deployment, None)
+                    return
+
+
+_routing: dict = {}
+_routing_lock = threading.Lock()
+
+
+def _routing_for(deployment: str) -> _DeploymentRouting:
+    with _routing_lock:
+        entry = _routing.get(deployment)
+        if entry is None:
+            entry = _routing[deployment] = _DeploymentRouting(deployment)
+        return entry
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 stream: bool = False):
+        self._deployment = deployment_name
+        self._method = method_name
+        self._stream = stream
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def _routing(self) -> _DeploymentRouting:
+        return _routing_for(self._deployment)
+
+    def _refresh(self, force: bool = False):
+        self._routing.refresh(force)
 
     def _pick_replica(self):
         """Power-of-two-choices (reference `router.py:639`): sample two,
         probe in-flight counts, route to the less loaded."""
         import ray_tpu
 
+        routing = self._routing
         self._refresh()
-        with self._lock:
-            replicas = list(self._replicas)
+        with routing.lock:
+            replicas = list(routing.replicas)
         deadline = time.time() + 30.0
         while not replicas:
             if time.time() > deadline:
@@ -72,8 +140,8 @@ class DeploymentHandle:
                     f"deployment {self._deployment!r} has no ready replicas")
             time.sleep(0.1)
             self._refresh(force=True)
-            with self._lock:
-                replicas = list(self._replicas)
+            with routing.lock:
+                replicas = list(routing.replicas)
         if len(replicas) == 1:
             a, b = replicas[0], None
         else:
@@ -90,8 +158,8 @@ class DeploymentHandle:
                 timeout=5.0)
         except Exception:  # noqa: BLE001 - stale replica: refetch, retry once
             self._refresh(force=True)
-            with self._lock:
-                replicas = list(self._replicas)
+            with routing.lock:
+                replicas = list(routing.replicas)
             if not replicas:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} lost its replicas")
@@ -101,12 +169,20 @@ class DeploymentHandle:
     # ------------------------------------------------------------- calling
 
     def remote(self, request: Any = None):
-        """Dispatch; returns an ObjectRef (resolve with ray_tpu.get)."""
+        """Dispatch; returns an ObjectRef (resolve with ray_tpu.get), or an
+        ObjectRefGenerator when the handle has ``stream=True``."""
         replica = self._pick_replica()
+        if self._stream:
+            return replica.handle_request_stream.options(
+                num_returns="streaming").remote(request, self._method)
         return replica.handle_request.remote(request, self._method)
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
-        return DeploymentHandle(self._deployment, method_name)
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._deployment,
+            self._method if method_name is None else method_name,
+            self._stream if stream is None else stream)
 
     @property
     def method(self):
@@ -114,7 +190,8 @@ class DeploymentHandle:
         return _MethodNamespace(self)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._deployment, self._method))
+        return (DeploymentHandle, (self._deployment, self._method,
+                                   self._stream))
 
     def __repr__(self):
         return f"DeploymentHandle({self._deployment!r})"

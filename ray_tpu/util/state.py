@@ -1,0 +1,122 @@
+"""State API — queryable cluster state.
+
+Reference analogue: `python/ray/util/state/api.py` (``list_actors`` `:782`,
+``list_nodes`` `:874`, ``list_tasks`` `:1009`, ``list_objects`` `:1054`,
+``summarize_tasks`` `:1367`) over the dashboard's StateAggregator.  Here the
+sources are the GCS tables (nodes/actors — cluster-wide) and the connected
+raylet's snapshot (tasks/objects — node-local views; cluster-wide task
+aggregation lands with GCS task-event export).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.worker import global_worker
+
+
+def _snapshot() -> dict:
+    w = global_worker()
+    if w.mode == "driver":
+        return w.raylet.call(w.raylet.state_snapshot).result()
+    if w.mode == "local":
+        return {"node_id": "local", "tasks": [], "actors": [],
+                "objects": {"num": 0}, "events": [],
+                "resources_total": {}, "resources_available": {}}
+    return w._request("state_snapshot")
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    """Cluster membership with resources (GCS node table)."""
+    w = global_worker()
+    return [
+        {
+            "node_id": n["node_id"],
+            "state": "ALIVE" if n.get("alive", True) else "DEAD",
+            "address": n.get("address"),
+            "hostname": n.get("hostname", ""),
+            "resources_total": n.get("resources_total", {}),
+            "resources_available": n.get("resources_available", {}),
+        }
+        for n in w.gcs_nodes()
+    ]
+
+
+def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Cluster-wide actor table (GCS) merged with the local raylet's
+    richer per-actor detail when available."""
+    w = global_worker()
+    local = {a["actor_id"]: a for a in _snapshot().get("actors", [])}
+    if w.mode == "driver":
+        gcs_actors = w.raylet.gcs.list_actors()
+    elif w.mode == "client":
+        gcs_actors = w.gcs.list_actors()
+    elif w.mode == "worker":
+        gcs_actors = w._request("gcs_list_actors")
+    else:
+        gcs_actors = []
+    out = {}
+    for a in gcs_actors:
+        out[a["actor_id"]] = {
+            "actor_id": a["actor_id"],
+            "state": a.get("state", "?").upper(),
+            "name": a.get("name"),
+            "owner_node": a.get("owner_node"),
+        }
+    for aid, a in local.items():
+        entry = out.setdefault(aid, {"actor_id": aid})
+        entry.update({
+            "state": a["state"].upper(),
+            "name": a.get("name"),
+            "pid": a.get("pid"),
+        })
+    results = list(out.values())
+    if state is not None:
+        results = [a for a in results if a.get("state") == state.upper()]
+    return results
+
+
+def list_tasks(state: Optional[str] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """Task table from the connected raylet's event log (latest state per
+    task)."""
+    tasks = list(_snapshot().get("tasks", []))
+    if state is not None:
+        tasks = [t for t in tasks if t["state"] == state.upper()]
+    return tasks[:limit]
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Object metadata known to the connected raylet."""
+    w = global_worker()
+    if w.mode != "driver":
+        snap = _snapshot()
+        return [{"count": snap.get("objects", {}).get("num", 0)}]
+
+    def collect():
+        return [
+            {
+                "object_id": oid.hex(),
+                "status": st.status,
+                "size": st.size,
+                "locations": list(st.locations),
+            }
+            for oid, st in list(w.raylet._objects.items())[:limit]
+        ]
+
+    return w.raylet.call(collect).result()
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """State -> count (reference: ``summarize_tasks``, `api.py:1367`)."""
+    return dict(Counter(t["state"] for t in _snapshot().get("tasks", [])))
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = list_objects(limit=100000)
+    if objs and "status" in objs[0]:
+        by_status = Counter(o["status"] for o in objs)
+        return {"total": len(objs), "by_status": dict(by_status),
+                "bytes_known": sum(o.get("size", 0) for o in objs)}
+    return {"total": objs[0]["count"] if objs else 0}

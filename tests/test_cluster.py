@@ -133,6 +133,25 @@ def test_cross_node_put_and_get_from_task(cluster):
     assert v == 7 and tuple(shape) == (2, 1 << 20)
 
 
+def test_cross_node_streaming(cluster):
+    """A streaming generator task forwarded to another node relays its
+    items back to the consumer-side raylet (xstream_item path)."""
+
+    @ray_tpu.remote(resources={"special": 0.1})
+    def gen(n):
+        import numpy as np
+
+        for i in range(n):
+            yield i * 3
+        yield np.full(300_000, 7, np.int64)  # store-path item relays too
+
+    refs = list(gen.options(num_returns="streaming").remote(3))
+    assert len(refs) == 4
+    vals = [ray_tpu.get(r, timeout=60) for r in refs[:3]]
+    assert vals == [0, 3, 6]
+    assert int(ray_tpu.get(refs[3], timeout=60)[0]) == 7
+
+
 class TestNodeFailure:
     """Node death: detection, task retry, actor failover (fresh cluster per
     test — killing nodes poisons the shared fixture)."""

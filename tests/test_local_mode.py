@@ -25,3 +25,16 @@ def test_local_mode(ray_local_mode):
     assert ray.get(c.incr.remote()) == 2
 
 
+
+
+def test_stream_local_mode(ray_local_mode):
+    ray = ray_local_mode
+
+    @ray.remote
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    vals = [ray.get(r) for r in
+            gen.options(num_returns="streaming").remote(3)]
+    assert vals == [0, 1, 2]

@@ -270,3 +270,115 @@ def test_dead_replica_is_replaced(served):
             time.sleep(0.3)
     assert recovered, f"replica never replaced: {serve.status()}"
     serve.delete("fragile")
+
+
+def test_longpoll_no_staleness_after_redeploy(served):
+    """Redeploy must switch handle traffic with no staleness window: once a
+    v2 response is seen, no later response may be v1, and no request may
+    error (reference: long-poll config push, `_private/long_poll.py:187`)."""
+
+    def make(version):
+        @serve.deployment(name="lp")
+        class V:
+            def __call__(self, req):
+                return {"version": version}
+
+        return V
+
+    h = serve.run(make(1).bind(), route_prefix="/lp")
+    assert ray_tpu.get(h.remote({}), timeout=30)["version"] == 1
+
+    errors = []
+    versions = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                versions.append(
+                    ray_tpu.get(h.remote({}), timeout=30)["version"])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    serve.run(make(2).bind(), route_prefix="/lp")  # in-place redeploy
+    deadline = time.time() + 15
+    while time.time() < deadline and (not versions or versions[-1] != 2):
+        time.sleep(0.1)
+    time.sleep(0.5)  # a few more requests at v2
+    stop.set()
+    t.join(timeout=10)
+    assert not errors, errors[:3]
+    assert 2 in versions
+    first_v2 = versions.index(2)
+    assert all(v == 2 for v in versions[first_v2:]), \
+        f"stale v1 after v2 at {first_v2}: {versions[first_v2:first_v2+20]}"
+    serve.delete("lp")
+
+
+def test_serve_batch_groups_requests(served):
+    """@serve.batch groups concurrent requests (>1 per batch under load)."""
+
+    @serve.deployment(name="batched", max_ongoing_requests=32)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        def __call__(self, reqs):
+            return [{"n": len(reqs), "v": r["v"] * 2} for r in reqs]
+
+        def batch_stats(self, _req):
+            return serve.batch_sizes_of(type(self).__call__)
+
+    h = serve.run(Batched.bind(), route_prefix="/batched")
+    refs = [h.remote({"v": i}) for i in range(16)]
+    outs = ray_tpu.get(refs, timeout=60)
+    assert [o["v"] for o in outs] == [i * 2 for i in range(16)]
+    sizes = ray_tpu.get(h.options(method_name="batch_stats").remote({}),
+                        timeout=30)
+    assert max(sizes) > 1, sizes  # grouping actually happened
+    assert sum(sizes) >= 16
+    serve.delete("batched")
+
+
+def test_http_streaming_response(served):
+    """?stream=1 returns chunked NDJSON, items flushed as produced."""
+
+    @serve.deployment(name="streamer")
+    class Streamer:
+        def __call__(self, req):
+            for i in range((req or {}).get("n", 3)):
+                time.sleep(0.15)
+                yield {"i": i}
+
+    serve.run(Streamer.bind(), route_prefix="/streamer")
+    port = serve.http_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/streamer?stream=1",
+        data=json.dumps({"n": 4}).encode(), method="POST")
+    req.add_header("Content-Type", "application/json")
+    t0 = time.perf_counter()
+    arrivals = []
+    items = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.status == 200
+        for line in resp:
+            items.append(json.loads(line))
+            arrivals.append(time.perf_counter() - t0)
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+    # first item must arrive before the full 0.6s production time
+    assert arrivals[0] < arrivals[-1] - 0.2, arrivals
+    serve.delete("streamer")
+
+
+def test_handle_streaming(served):
+    @serve.deployment(name="hstream")
+    def gen(req):
+        for i in range(req["n"]):
+            yield i * 10
+
+    h = serve.run(gen.bind(), route_prefix="/hstream")
+    vals = [ray_tpu.get(r, timeout=30)
+            for r in h.options(stream=True).remote({"n": 3})]
+    assert vals == [0, 10, 20]
+    serve.delete("hstream")

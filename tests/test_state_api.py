@@ -1,0 +1,67 @@
+"""State API + CLI (reference: `python/ray/util/state/api.py:782+`,
+`python/ray/scripts/scripts.py:540`)."""
+
+import json
+import subprocess
+import sys
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def test_state_api_embedded(ray_shared):
+    ray = ray_shared
+
+    @ray.remote
+    def work(x):
+        return x + 1
+
+    @ray.remote
+    class Keeper:
+        def ping(self):
+            return "pong"
+
+    k = Keeper.options(name="keeper").remote()
+    ray.get(k.ping.remote())
+    ray.get([work.remote(i) for i in range(5)])
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["resources_total"].get("CPU")
+
+    actors = state.list_actors()
+    assert any(a.get("name") == "keeper" and a["state"] == "ALIVE"
+               for a in actors)
+
+    tasks = state.list_tasks()
+    assert any(t["name"] == "work" and t["state"] == "FINISHED"
+               for t in tasks)
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 5
+
+    objs = state.summarize_objects()
+    assert objs["total"] >= 5
+
+
+def test_cli_status_and_list_on_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    with Cluster(initialize_head=True,
+                 head_resources={"num_cpus": 2}) as c:
+        c.wait_for_nodes(1)
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "status",
+             "--address", c.address],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr[-400:]
+        assert "nodes: 1 alive" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "list", "nodes",
+             "--address", c.address],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr[-400:]
+        row = json.loads(out.stdout.strip().splitlines()[0])
+        assert row["state"] == "ALIVE"
