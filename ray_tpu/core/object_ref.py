@@ -13,10 +13,29 @@ from ray_tpu.core.ids import ObjectID
 
 
 class ObjectRef:
+    """Reference-counted handle: every live ObjectRef in a process counts
+    one local reference; when a process's count for an object drops to
+    zero it notifies the raylet, which frees the object once NO process
+    holds it and no queued task depends on it (reference: distributed ref
+    counting, `src/ray/core_worker/reference_count.h:61` — minus the full
+    borrowing protocol: refs stashed inside long-lived actor state on
+    OTHER nodes must be kept alive by the creator or `ray_tpu.put`)."""
+
     __slots__ = ("_id", "__weakref__")
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
+        from ray_tpu.core import worker as _w
+
+        _w.note_ref_created(object_id)
+
+    def __del__(self):
+        try:
+            from ray_tpu.core import worker as _w
+
+            _w.note_ref_dropped(self._id)
+        except Exception:  # noqa: BLE001 interpreter teardown
+            pass
 
     def id(self) -> ObjectID:
         return self._id

@@ -62,6 +62,12 @@ config.define("spillback_max_hops", int, 4,
 config.define("object_transfer_chunk_bytes", int, 4 << 20,
               "Chunk size for raylet-to-raylet object pulls (reference: "
               "chunked gRPC push/pull, object_manager.h:117).")
+config.define("ref_free_grace_s", float, 2.0,
+              "Delay between an object's ref count reaching zero and the "
+              "actual free (covers refs in transit inside results).")
+config.define("max_lineage_entries", int, 20000,
+              "Max objects whose creating TaskSpec is retained for "
+              "eviction recovery (reference: lineage byte caps).")
 
 # ---------------------------------------------------------------------------
 
@@ -109,6 +115,9 @@ class _WorkerConn:
         # so object waiter lists don't accumulate dead callbacks.
         self.request_cancels: Dict[int, Callable] = {}
         self.actor_id: Optional[ActorID] = None
+        # oid -> hold count announced by this process (auto-released on
+        # process death)
+        self.held: Dict[ObjectID, int] = {}
         self.send_lock = threading.Lock()
 
     def send(self, msg):
@@ -116,7 +125,9 @@ class _WorkerConn:
 
 
 class _ObjectState:
-    __slots__ = ("status", "value", "error", "size", "locations")
+    __slots__ = ("status", "value", "error", "size", "locations",
+                 "holders", "pins", "tracked", "creating_spec",
+                 "free_armed")
 
     def __init__(self):
         # pending | inline | store | remote | error
@@ -128,6 +139,12 @@ class _ObjectState:
         self.error: Optional[Exception] = None
         self.size = 0
         self.locations: List[str] = []
+        # --- reference counting (reference: reference_count.h:61) ---
+        self.holders = 0        # processes holding live ObjectRefs
+        self.pins = 0           # queued/submitted tasks depending on this
+        self.tracked = False    # ever held => eligible for auto-free
+        self.creating_spec: Optional["TaskSpec"] = None  # lineage
+        self.free_armed = False
 
 
 class _PeerConn:
@@ -304,6 +321,9 @@ class Raylet:
         # (each yielded item is relayed so the consumer-side stream state
         # advances — covers actor-routed and node-affinity streaming tasks).
         self._foreign_streams: Dict[TaskID, str] = {}
+        # lineage bookkeeping (bounded; see submit_task)
+        self._lineage_count = 0
+        self._reconstructing: set = set()
 
         # ---- cluster state (all event-thread owned) ----
         self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
@@ -596,6 +616,7 @@ class Raylet:
         for cancel in list(conn.request_cancels.values()):
             self._safe(cancel)
         conn.request_cancels.clear()
+        self._release_conn_holds(conn)
         if conn.actor_id is not None:
             self._on_actor_death(conn.actor_id, "worker process died")
         else:
@@ -652,6 +673,8 @@ class Raylet:
             self._on_task_done(conn, msg)
         elif t == "stream_item":
             self._on_stream_item(msg)
+        elif t == "ref_events":
+            self.apply_ref_events(msg["events"], conn)
         elif t == "submit":
             self.submit_task(msg["spec"])
         elif t == "request":
@@ -1255,6 +1278,139 @@ class Raylet:
                 pending = True
         return pending
 
+    # --------------------------------------------------------------- refcount
+
+    def apply_ref_events(self, events: List[Tuple[str, ObjectID]],
+                         conn: Optional[_WorkerConn] = None):
+        """Ordered hold ("h") / release ("r") transitions from one process
+        (reference: ReferenceCounter updates).  Free happens only after a
+        grace period at zero — covers the window where a ref travels
+        inside a serialized result before the receiver announces its
+        hold (the full borrowing protocol's job).  ``conn``-attributed
+        holds are force-released if the process dies without flushing."""
+        for kind, oid in events:
+            st = self._obj(oid)
+            if kind == "h":
+                st.holders += 1
+                st.tracked = True
+                if conn is not None:
+                    conn.held[oid] = conn.held.get(oid, 0) + 1
+            else:
+                st.holders -= 1
+                if conn is not None:
+                    n = conn.held.get(oid, 0) - 1
+                    if n <= 0:
+                        conn.held.pop(oid, None)
+                    else:
+                        conn.held[oid] = n
+                self._maybe_free(oid)
+
+    def _release_conn_holds(self, conn: _WorkerConn):
+        """A worker/driver process died: drop every hold it still had."""
+        for oid, n in conn.held.items():
+            st = self._objects.get(oid)
+            if st is not None:
+                st.holders -= n
+                self._maybe_free(oid)
+        conn.held.clear()
+
+    def release_refs(self, oids: List[ObjectID]):
+        self.apply_ref_events([("r", o) for o in oids])
+
+    def _maybe_free(self, oid: ObjectID):
+        st = self._objects.get(oid)
+        if (st is None or not st.tracked or st.holders > 0 or st.pins > 0
+                or st.free_armed):
+            return
+        if st.status == "pending":
+            # in-flight result: never drop the entry (and its lineage) out
+            # from under the producing task — re-checked on resolution
+            # (_object_ready calls _maybe_free)
+            return
+        if oid in self._dep_index or oid in self._object_waiters:
+            return
+        st.free_armed = True
+        self.add_timer(config.ref_free_grace_s,
+                       lambda: self._free_if_unreferenced(oid))
+
+    def _free_if_unreferenced(self, oid: ObjectID):
+        st = self._objects.get(oid)
+        if st is None:
+            return
+        st.free_armed = False
+        if (st.holders > 0 or st.pins > 0 or st.status == "pending"
+                or oid in self._dep_index or oid in self._object_waiters):
+            return
+        del self._objects[oid]
+        if st.creating_spec is not None:
+            self._lineage_count -= 1
+        if st.status == "store":
+            store = self._raylet_store()
+            if store is not None:
+                try:
+                    store.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+        if self.cluster_mode:
+            self._gcs_post("remove_object_location", oid.hex(), self.node_id)
+
+    def _pin_deps(self, spec: TaskSpec):
+        """Pin dependency objects for the task's lifetime: released when
+        every return resolves (the same all-paths completion signal the
+        cluster xdone path uses)."""
+        deps = spec.dependency_ids()
+        if not deps:
+            return
+        for oid in deps:
+            self._obj(oid).pins += 1
+
+        def unpin(_results, deps=deps):
+            for oid in deps:
+                st = self._objects.get(oid)
+                if st is not None:
+                    st.pins -= 1
+                    self._maybe_free(oid)
+
+        self.async_get(spec.return_ids(), unpin)
+
+    def reconstruct_object(self, oid: ObjectID, _depth: int = 0) -> bool:
+        """Lineage reconstruction (reference: ObjectRecoveryManager,
+        `object_recovery_manager.h:41`): re-run the task that created an
+        object whose bytes were evicted; missing dependencies reconstruct
+        recursively (bounded depth)."""
+        st = self._objects.get(oid)
+        spec = st.creating_spec if st is not None else None
+        if spec is None or spec.kind != NORMAL_TASK or _depth > 8:
+            return False
+        if spec.task_id in self._reconstructing:
+            return True  # already re-running; the waiter resolves with it
+        store = self._raylet_store()
+        if (st.status == "store" and store is not None
+                and store.contains(oid)):
+            return True  # false alarm: bytes are present
+        for rid in spec.return_ids():
+            s2 = self._obj(rid)
+            if s2.status in ("store", "remote"):
+                s2.status = "pending"
+                s2.locations = []
+        for dep in spec.dependency_ids():
+            ds = self._objects.get(dep)
+            if ds is None or ds.status == "pending":
+                if not self.reconstruct_object(dep, _depth + 1):
+                    return False
+            elif ds.status == "store" and store is not None \
+                    and not store.contains(dep):
+                if not self.reconstruct_object(dep, _depth + 1):
+                    return False
+        spec._acquired_pool = None
+        self._reconstructing.add(spec.task_id)
+        self.async_get(
+            spec.return_ids(),
+            lambda _r, t=spec.task_id: self._reconstructing.discard(t))
+        self._record_event(spec, "RECONSTRUCTING")
+        self.submit_task(spec)
+        return True
+
     # --------------------------------------------------------------- streams
 
     def _init_stream(self, spec: TaskSpec):
@@ -1452,6 +1608,7 @@ class Raylet:
                 self._safe(lambda cb=cb: cb(oid))
         elif status == "remote" and oid in self._object_waiters:
             self._maybe_pull(oid)
+        self._maybe_free(oid)  # nobody may have held it by now
         self._schedule()
 
     def _object_status(self, oid: ObjectID) -> str:
@@ -1467,8 +1624,18 @@ class Raylet:
         (which stays the owner of actors and handles restarts); skip the
         owner-side registrations.
         """
+        # Lineage for eviction recovery: NORMAL tasks only (actor results
+        # aren't replayable) and bounded — beyond the cap new objects lose
+        # reconstructability instead of the raylet growing without limit
+        # (reference bounds lineage bytes, ray_config_def.h lineage caps).
+        keep_lineage = (spec.kind == NORMAL_TASK
+                        and self._lineage_count < config.max_lineage_entries)
         for oid in spec.return_ids():
-            self._obj(oid)
+            st = self._obj(oid)
+            if keep_lineage and st.creating_spec is None:
+                st.creating_spec = spec
+                self._lineage_count += 1
+        self._pin_deps(spec)
         if spec.num_returns == STREAMING_RETURNS:
             self._init_stream(spec)
         if spec.kind == ACTOR_CREATION_TASK:
@@ -2070,6 +2237,9 @@ class Raylet:
                     msg["task_id"], msg["index"], deferred_reply)
                 if cancel is not None:
                     conn.request_cancels[rid] = cancel
+            elif op == "reconstruct":
+                reply(value=self.reconstruct_object(
+                    ObjectID.from_hex(msg["id"])))
             elif op == "cancel_task":
                 reply(value=self.cancel_task(ObjectID.from_hex(msg["id"])))
             elif op == "available_resources":

@@ -45,6 +45,66 @@ LOCAL = "local"
 _global_worker: Optional["Worker"] = None
 _init_lock = threading.Lock()
 
+# ---------------------------------------------------------------------------
+# Process-local reference counting (reference: ReferenceCounter,
+# `src/ray/core_worker/reference_count.h:61`).  ObjectRef __init__/__del__
+# call these; when this process's count for an object reaches zero the
+# worker tells its raylet, which frees the object once nobody holds it.
+
+_ref_counts: Dict["ObjectID", int] = {}
+# RLock: a GC pass triggered by an allocation INSIDE these functions can
+# finalize an ObjectRef on the same thread, re-entering note_ref_dropped.
+_ref_lock = threading.RLock()
+_pending_events: List[tuple] = []  # ordered ("h"|"r", ObjectID)
+
+
+def note_ref_created(oid):
+    flush = None
+    with _ref_lock:
+        n = _ref_counts.get(oid, 0)
+        _ref_counts[oid] = n + 1
+        if n == 0:
+            _pending_events.append(("h", oid))
+            if len(_pending_events) >= 8:
+                flush = list(_pending_events)
+                _pending_events.clear()
+    if flush is not None:
+        _flush_events(flush)
+
+
+def note_ref_dropped(oid):
+    flush = None
+    with _ref_lock:
+        n = _ref_counts.get(oid, 0) - 1
+        if n > 0:
+            _ref_counts[oid] = n
+            return
+        _ref_counts.pop(oid, None)
+        _pending_events.append(("r", oid))
+        if len(_pending_events) >= 8:
+            flush = list(_pending_events)
+            _pending_events.clear()
+    if flush is not None:
+        _flush_events(flush)
+
+
+def flush_pending_releases():
+    with _ref_lock:
+        flush = list(_pending_events)
+        _pending_events.clear()
+    if flush:
+        _flush_events(flush)
+
+
+def _flush_events(events):
+    w = _global_worker
+    if w is None:
+        return
+    try:
+        w.send_ref_events(events)
+    except Exception:  # noqa: BLE001 shutdown races
+        pass
+
 
 def global_worker() -> "Worker":
     if _global_worker is None:
@@ -122,7 +182,22 @@ class Worker:
             self._send({"t": "submit", "spec": spec})
         return refs
 
+    def send_ref_events(self, events: List[tuple]):
+        """Ordered hold/release transitions for this process's ObjectRefs."""
+        if self.mode == DRIVER:
+            self.raylet.call_async(self.raylet.apply_ref_events, events)
+        elif self.mode == LOCAL:
+            for kind, oid in events:
+                if kind == "r":
+                    self._objects.pop(oid, None)
+        else:
+            try:
+                self._send({"t": "ref_events", "events": events})
+            except Exception:  # noqa: BLE001 socket teardown
+                pass
+
     def put(self, value) -> ObjectRef:
+        flush_pending_releases()  # free before allocating under pressure
         oid = put_counter.next_object_id()
         ser = self._serialize_value(value)
         size = ser.total_bytes()
@@ -182,8 +257,53 @@ class Worker:
             if kind == "inline":
                 out.append(serialization.loads(rest[0]))
             else:  # store
-                out.append(self.store.get(oid))
+                out.append(self.read_store_object(oid, timeout=timeout or 60.0))
         return out
+
+    def read_store_object(self, oid, attempts: int = 3,
+                          timeout: Optional[float] = 60.0):
+        """Store read with transparent lineage recovery: an LRU-evicted
+        object is reconstructed by re-running its creating task
+        (reference: `object_recovery_manager.h:41`).  ``timeout`` bounds
+        each reseal wait (the re-executed task could hang)."""
+        from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
+
+        for attempt in range(attempts):
+            try:
+                return self.store.get(oid)
+            except ObjectLostError:
+                if attempt == attempts - 1 or not self.reconstruct(oid):
+                    raise
+                # block until resealed (or inline/error this time around)
+                try:
+                    result = self._blocking_get_status([oid],
+                                                       timeout)[oid.hex()]
+                except TimeoutError:
+                    raise GetTimeoutError(
+                        f"reconstruction of {oid.hex()} timed out after "
+                        f"{timeout}s") from None
+                if result[0] == "inline":
+                    return serialization.loads(result[1])
+                if result[0] == "error":
+                    raise result[1]
+
+    def _blocking_get_status(self, oids, timeout: Optional[float] = None):
+        if self.mode == DRIVER:
+            from ray_tpu.core.raylet import SimpleFuture
+
+            fut = SimpleFuture()
+            self.raylet.call(self.raylet.async_get, oids, fut.set)
+            return fut.result(timeout)
+        return self._request("get", ids=[o.hex() for o in oids],
+                             _wait_timeout=timeout)
+
+    def reconstruct(self, oid) -> bool:
+        if self.mode == DRIVER:
+            return bool(self.raylet.call(
+                self.raylet.reconstruct_object, oid).result())
+        if self.mode == LOCAL:
+            return False
+        return bool(self._request("reconstruct", id=oid.hex()))
 
     def wait(self, refs: Sequence[ObjectRef], num_returns=1,
              timeout: Optional[float] = None):

@@ -125,7 +125,8 @@ def _resolve_args(worker: RemoteWorker, spec: TaskSpec, arg_values):
             return serialization.loads(blob)
         if worker.store is None:
             raise RuntimeError("no object store attached")
-        return worker.store.get(oid, timeout=60.0)
+        # evicted arg -> lineage reconstruction via the raylet
+        return worker.read_store_object(oid)
 
     args = [resolve(a) for a in spec.args]
     kwargs = {k: resolve(v) for k, v in spec.kwargs}

@@ -39,6 +39,14 @@ class GPT2Config:
     compute_dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | ring | ulysses | dense
     remat: bool = False      # jax.checkpoint each block (trade FLOPs for HBM)
+    # MoE (expert parallelism, SURVEY §2.6 row "EP"): >0 swaps every
+    # block's dense FFN for a top-k routed mixture; expert weights carry a
+    # leading "expert" dim that ShardingConfig places on the ep axis (XLA
+    # SPMD emits the all_to_all dispatch).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -67,8 +75,8 @@ def init_params(rng, cfg: GPT2Config) -> Dict[str, Any]:
         "ln_f": {"scale": jnp.ones((cfg.n_embd,)), "bias": jnp.zeros((cfg.n_embd,))},
     }
     for i in range(cfg.n_layer):
-        k1, k2, k3, k4 = jax.random.split(keys[4 + i], 4)
-        params[f"h_{i}"] = {
+        k1, k2, k3, k4, k5 = jax.random.split(keys[4 + i], 5)
+        block = {
             "ln_1": {"scale": jnp.ones((cfg.n_embd,)),
                      "bias": jnp.zeros((cfg.n_embd,))},
             "attn": {
@@ -80,14 +88,25 @@ def init_params(rng, cfg: GPT2Config) -> Dict[str, Any]:
             },
             "ln_2": {"scale": jnp.ones((cfg.n_embd,)),
                      "bias": jnp.zeros((cfg.n_embd,))},
-            "mlp": {
+        }
+        if cfg.moe_experts > 0:
+            block["moe"] = {
+                "router": {"kernel": normal(k5, (cfg.n_embd,
+                                                 cfg.moe_experts))},
+                "wi": normal(k3, (cfg.moe_experts, cfg.n_embd,
+                                  4 * cfg.n_embd)),
+                "wo": normal(k4, (cfg.moe_experts, 4 * cfg.n_embd,
+                                  cfg.n_embd), proj_std),
+            }
+        else:
+            block["mlp"] = {
                 "c_fc": {"kernel": normal(k3, (cfg.n_embd, 4 * cfg.n_embd)),
                          "bias": jnp.zeros((4 * cfg.n_embd,))},
                 "c_proj": {"kernel": normal(k4, (4 * cfg.n_embd, cfg.n_embd),
                                             proj_std),
                            "bias": jnp.zeros((cfg.n_embd,))},
-            },
-        }
+            }
+        params[f"h_{i}"] = block
     return params
 
 
@@ -130,31 +149,125 @@ def _mlp(x, p):
     return h @ p["c_proj"]["kernel"].astype(x.dtype) + p["c_proj"]["bias"].astype(x.dtype)
 
 
-def _block(x, p, cfg: GPT2Config):
+def _moe_mlp(x, p, cfg: GPT2Config):
+    """Top-k routed mixture-of-experts FFN (GShard/Switch-style capacity
+    dispatch; SURVEY §2.6 row "EP").  Expert weights carry a leading
+    expert dim; sharded on the ep mesh axis the dispatch/combine einsums
+    lower to all_to_all under the XLA SPMD partitioner.  The dense
+    (T, n_exp, C) dispatch tensors are fine at the capacities used here;
+    a sort-based dispatch is the optimization path for very long
+    sequences.  Returns (y, aux_load_balancing_loss)."""
+    B, S, E = x.shape
+    T = B * S
+    k = cfg.moe_top_k
+    n_exp = cfg.moe_experts
+    xt = x.reshape(T, E)
+    router_logits = (xt @ p["router"]["kernel"].astype(x.dtype)
+                     ).astype(jnp.float32)                      # (T, n_exp)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)               # (T, k)
+    gate_vals = gate_vals / (jnp.sum(gate_vals, -1, keepdims=True) + 1e-9)
+    capacity = max(k, int(cfg.moe_capacity_factor * T * k / n_exp))
+    mask = jax.nn.one_hot(gate_idx, n_exp, dtype=jnp.float32)   # (T, k, n)
+    # slot positions: earlier tokens and lower-k choices win capacity
+    positions = []
+    counts = jnp.zeros((n_exp,), jnp.float32)
+    for j in range(k):
+        mj = mask[:, j]                                         # (T, n)
+        positions.append(jnp.cumsum(mj, axis=0) - 1 + counts)
+        counts = counts + jnp.sum(mj, axis=0)
+    pos = jnp.stack(positions, axis=1)                          # (T, k, n)
+    keep = mask * (pos < capacity)
+    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)     # (T,k,n,C)
+    dispatch = jnp.einsum("tkn,tknc->tnc", keep, slot)
+    combine = jnp.einsum("tk,tkn,tknc->tnc", gate_vals, keep, slot)
+    expert_in = jnp.einsum("te,tnc->nce", xt,
+                           dispatch.astype(x.dtype))            # (n, C, E)
+    h = jax.nn.gelu(jnp.einsum("nce,neh->nch", expert_in,
+                               p["wi"].astype(x.dtype)))
+    expert_out = jnp.einsum("nch,nhe->nce", h, p["wo"].astype(x.dtype))
+    y = jnp.einsum("nce,tnc->te", expert_out, combine.astype(x.dtype))
+    # load-balancing aux (Switch eq. 4): fraction routed x router prob
+    frac = jnp.mean(mask[:, 0], axis=0)
+    importance = jnp.mean(probs, axis=0)
+    aux = n_exp * jnp.sum(frac * importance)
+    return y.reshape(B, S, E), aux
+
+
+def _block(x, p, cfg: GPT2Config, aux_acc=None):
     x = x + _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg)
-    x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+    if "moe" in p:
+        y, aux = _moe_mlp(_layer_norm(x, p["ln_2"]), p["moe"], cfg)
+        if aux_acc is not None:
+            aux_acc.append(aux)
+        x = x + y
+    else:
+        x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
     return x
 
 
-def _trunk(params, tokens, cfg: GPT2Config):
+def to_pipeline_params(params, cfg: GPT2Config):
+    """Stack the per-layer blocks into one leading-layer-dim pytree (the
+    "stage" axis `ShardingConfig` places on pp); non-block params pass
+    through.  Use with ``forward``/``make_train_step`` on a mesh whose pp
+    axis > 1."""
+    from ray_tpu.parallel.pipeline import stack_layer_params
+
+    out = {k: v for k, v in params.items() if not k.startswith("h_")}
+    out["blocks"] = stack_layer_params(
+        [params[f"h_{i}"] for i in range(cfg.n_layer)])
+    return out
+
+
+def _trunk(params, tokens, cfg: GPT2Config, aux_acc=None,
+           pp_microbatches: int = 2):
     """Embedding + transformer blocks + final LN -> (B, S, E) in
-    compute_dtype (the LN itself runs f32 for stability)."""
+    compute_dtype (the LN itself runs f32 for stability).  With stacked
+    ``blocks`` params (see to_pipeline_params) the block stack runs as a
+    pipeline over the mesh pp axis (MoE aux loss is skipped on that path:
+    scalars can't ride the activation handoff)."""
     S = tokens.shape[1]
     x = (params["wte"]["embedding"][tokens]
          + params["wpe"]["embedding"][:S][None])
     x = x.astype(cfg.compute_dtype)
-    block = _block
-    if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(2,))
-    for i in range(cfg.n_layer):
-        x = block(x, params[f"h_{i}"], cfg)
+    if "blocks" in params:
+        from ray_tpu.parallel.context import require_mesh
+        from ray_tpu.parallel.pipeline import pipeline_apply
+
+        if cfg.moe_experts > 0 and aux_acc is not None:
+            import warnings
+
+            warnings.warn(
+                "MoE load-balancing aux loss is not collected on the "
+                "pipeline-parallel path (scalars don't ride the stage "
+                "handoff); training optimizes cross-entropy only",
+                stacklevel=2)
+        x = pipeline_apply(
+            lambda p, h: _block(h, p, cfg),
+            params["blocks"], x, require_mesh(), pp_microbatches)
+    elif cfg.remat:
+        def _remat_body(h, p):
+            acc: list = []
+            h2 = _block(h, p, cfg, acc)
+            aux = acc[0] if acc else jnp.zeros((), jnp.float32)
+            return h2, aux
+
+        rblock = jax.checkpoint(_remat_body)
+        for i in range(cfg.n_layer):
+            x, aux = rblock(x, params[f"h_{i}"])
+            if aux_acc is not None and cfg.moe_experts > 0:
+                aux_acc.append(aux)
+    else:
+        for i in range(cfg.n_layer):
+            x = _block(x, params[f"h_{i}"], cfg, aux_acc)
     x = _layer_norm(x.astype(jnp.float32), params["ln_f"])
     return x.astype(cfg.compute_dtype)
 
 
-def forward(params, tokens, cfg: GPT2Config):
+def forward(params, tokens, cfg: GPT2Config, aux_acc=None,
+            pp_microbatches: int = 2):
     """tokens (B, S) int32 -> logits (B, S, vocab) f32."""
-    x = _trunk(params, tokens, cfg)
+    x = _trunk(params, tokens, cfg, aux_acc, pp_microbatches)
     # Tied lm head: bf16 operands on the MXU (an f32 head costs ~30% of
     # model FLOPs at the slow f32 MXU rate) with an f32 accumulate/output
     # so the softmax sees full-precision logits.
@@ -162,8 +275,9 @@ def forward(params, tokens, cfg: GPT2Config):
     return jnp.matmul(x, wte.T, preferred_element_type=jnp.float32)
 
 
-def loss_fn(params, batch, cfg: GPT2Config):
-    """batch: {"tokens": (B, S+1)} — next-token cross entropy.
+def loss_fn(params, batch, cfg: GPT2Config, pp_microbatches: int = 2):
+    """batch: {"tokens": (B, S+1)} — next-token cross entropy (+ MoE
+    load-balancing aux when the model is a mixture).
 
     logsumexp form (lse - logit_at_target) rather than materializing
     log_softmax: one fused reduction over the vocab axis instead of an
@@ -171,18 +285,24 @@ def loss_fn(params, batch, cfg: GPT2Config):
     """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg)
+    aux_acc: list = []
+    logits = forward(params, inputs, cfg, aux_acc, pp_microbatches)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    loss = jnp.mean(lse - tgt)
+    if aux_acc:
+        loss = loss + cfg.moe_aux_weight * sum(aux_acc) / len(aux_acc)
+    return loss
 
 
-def make_train_step(cfg: GPT2Config, optimizer):
+def make_train_step(cfg: GPT2Config, optimizer, pp_microbatches: int = 2):
     """Returns train_step(params, opt_state, batch) -> (params, opt_state,
-    metrics) — jit it with the appropriate shardings."""
+    metrics) — jit it with the appropriate shardings.  Works for dense,
+    MoE, and pipeline-stacked params alike."""
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg,
+                                                  pp_microbatches)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, {"loss": loss}

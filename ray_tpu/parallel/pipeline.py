@@ -1,0 +1,113 @@
+"""Pipeline parallelism — staged execution over the mesh "pp" axis.
+
+SURVEY.md §2.6 greenfield row "PP" (the reference has no native pipeline
+parallelism; users reach for DeepSpeed).  TPU-native design: the WHOLE
+pipeline — microbatch loop, per-stage layer stack, activation handoffs —
+is ONE jit program:
+
+  * the layer-stacked block params (leading dim = n_layer) shard across
+    the ``pp`` axis, giving each stage ``n_layer / pp_size`` consecutive
+    layers;
+  * a ``lax.scan`` runs the GPipe fill/drain schedule: at tick t, stage 0
+    ingests microbatch t while stage s processes the activation it
+    received from stage s-1, then every stage hands its output to the
+    next stage via ``lax.ppermute`` (one ICI hop on a TPU torus);
+  * only ``pp`` is manual (`shard_map` ``axis_names={'pp'}``): tensor/
+    data/sequence sharding inside each stage stays with the XLA SPMD
+    partitioner, so PP composes with tp/fsdp/dp from `ShardingConfig`.
+
+Backward is plain autodiff through the scan: XLA re-runs the schedule in
+reverse with ppermute transposed (the activations hop backwards), which
+is the same communication pattern a hand-written 1F1B backward performs;
+per-microbatch rematerialization (``jax.checkpoint`` around the stage
+body) keeps the live activation set to stages x microbatch, not the full
+batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_layer_params(layer_params: list):
+    """[per-layer pytree] -> single pytree with leading layer dim (the
+    shardable "stage" axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_params)
+
+
+def pipeline_apply(
+    block_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+    remat: bool = True,
+):
+    """Run ``n_layer`` blocks (stacked leading dim, sharded on ``axis``)
+    over ``x`` (batch-leading) with a GPipe microbatch schedule.
+
+    block_fn(params_one_layer, x) -> x.  Output is bitwise the same
+    function as applying the layers sequentially (the schedule only
+    reorders work), so pp>1 losses match single-device runs.
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    M = num_microbatches
+    if batch % M:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {M}")
+    mbs = x.reshape(M, batch // M, *x.shape[1:])
+
+    def stage_body(params_local, x_in):
+        # params_local: (layers_per_stage, ...) — this stage's slice
+        def layer_step(h, p_layer):
+            return block_fn(p_layer, h), None
+
+        body = layer_step
+        if remat:
+            body = jax.checkpoint(layer_step)
+        out, _ = jax.lax.scan(body, x_in, params_local)
+        return out
+
+    def pipelined(params_local, mbs):
+        idx = jax.lax.axis_index(axis)
+        n_ticks = M + n_stages - 1
+        # carries are per-stage state: mark them pp-varying up front
+        buf = jax.lax.pcast(jnp.zeros_like(mbs[0]), (axis,), to="varying")
+        outs = jax.lax.pcast(jnp.zeros_like(mbs), (axis,), to="varying")
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (clipped; masked after drain)
+            feed = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(idx == 0, feed, buf)
+            y = stage_body(params_local, x_in)
+            # last stage emits microbatch t-(n_stages-1)
+            w = t - (n_stages - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(w, 0, M - 1), 0)
+            outs = jnp.where((idx == n_stages - 1) & (w >= 0), upd, outs)
+            buf = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (buf, outs), None
+
+        (buf, outs), _ = jax.lax.scan(tick, (buf, outs),
+                                      jnp.arange(n_ticks))
+        # only the last stage holds real outputs; make them pp-invariant
+        outs = jnp.where(idx == n_stages - 1, outs, 0.0)
+        return jax.lax.psum(outs, axis)
+
+    spec_tree = jax.tree.map(lambda _: P(axis), stacked_params)
+    out = jax.shard_map(
+        pipelined, mesh=mesh,
+        in_specs=(spec_tree, P()), out_specs=P(),
+        axis_names={axis},
+    )(stacked_params, mbs)
+    return out.reshape(batch, *x.shape[1:])

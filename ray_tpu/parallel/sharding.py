@@ -120,9 +120,19 @@ def infer_param_logical_dims(path: Tuple[str, ...], shape: Tuple[int, ...]):
     when a model doesn't annotate its params explicitly.
     """
     name = "/".join(str(p) for p in path).lower()
+    if path and str(path[0]) == "blocks":
+        # pipeline-stacked block params: leading layer dim = "stage" (pp)
+        inner = infer_param_logical_dims(path[1:], shape[1:])
+        return ("stage",) + tuple(inner)
     nd = len(shape)
     if nd == 0:
         return ()
+    if "router" in name:
+        return ("embed", None)[:nd]
+    if "moe" in name and "/wi" in name:
+        return ("expert", "embed", "mlp")[:nd]
+    if "moe" in name and "/wo" in name:
+        return ("expert", "mlp", "embed")[:nd]
     if "embedding" in name or "wte" in name or "embed_tokens" in name:
         return ("vocab", "embed")[:nd] if nd >= 2 else ("embed",)
     if "wpe" in name or "pos_emb" in name:

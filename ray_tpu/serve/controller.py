@@ -60,6 +60,7 @@ class _DeploymentState:
         # deployment is marked unhealthy instead of respawn-looping
         self.start_failures = 0
         self.unhealthy_reason: Optional[str] = None
+        self.flip_at: Optional[float] = None  # rollout traffic-flip time
 
 
 class ServeController:
@@ -94,6 +95,7 @@ class ServeController:
                     # there is no empty-replica window.
                     existing.spec = spec
                     existing.target = self._initial_target(spec)
+                    existing.flip_at = None
                     now = time.time()
                     for r in existing.replicas:
                         if not r.draining:
@@ -350,6 +352,12 @@ class ServeController:
         if fresh_ready < st.target and st.target > 0:
             return  # old version still carries the traffic
         now = time.time()
+        # moment traffic flipped to the new version: in-flight picks made
+        # against the old routing need a beat to land before any kill
+        if st.flip_at is None:
+            st.flip_at = now
+        if now - st.flip_at < 0.75:
+            return
         for r in draining:
             idle = False
             if r.dead:

@@ -215,3 +215,81 @@ def test_host_collectives(ray_shared):
     results = ray.get([rank_fn.remote(4, r) for r in range(4)], timeout=120)
     assert all(t == 10.0 for t, _ in results)
     assert all(g == 20.0 for _, g in results)
+
+
+def test_moe_ep_sharded_matches_single_device():
+    """Expert-parallel MoE: loss on an ep-sharded mesh matches the
+    unsharded computation (XLA SPMD dispatches via all_to_all)."""
+    from dataclasses import replace
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.context import use_mesh
+
+    cfg = replace(gpt2.GPT2_TINY, moe_experts=4, attention="dense",
+                  compute_dtype=jnp.float32)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    ref = float(gpt2.loss_fn(params, {"tokens": tokens}, cfg))
+
+    scfg = ShardingConfig(ep=2, tp=2, dp=2)
+    mesh = scfg.build_mesh()
+    sharded = shard_params(params, scfg, mesh)
+    with use_mesh(mesh):
+        got = float(jax.jit(lambda p, b: gpt2.loss_fn(p, b, cfg))(
+            sharded, {"tokens": tokens}))
+    assert abs(got - ref) < 1e-3, (got, ref)
+
+
+def test_pipeline_matches_sequential():
+    """pp=2 pipelined blocks produce the same loss as the sequential
+    single-device model (the GPipe schedule only reorders work)."""
+    from dataclasses import replace
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.context import use_mesh
+
+    cfg = replace(gpt2.GPT2_TINY, attention="dense",
+                  compute_dtype=jnp.float32)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    ref = float(gpt2.loss_fn(params, {"tokens": tokens}, cfg))
+
+    scfg = ShardingConfig(pp=2, tp=2, dp=2)
+    mesh = scfg.build_mesh()
+    pp_params = shard_params(gpt2.to_pipeline_params(params, cfg),
+                             scfg, mesh)
+    with use_mesh(mesh):
+        got = float(jax.jit(
+            lambda p, b: gpt2.loss_fn(p, b, cfg, 2))(
+                pp_params, {"tokens": tokens}))
+    assert abs(got - ref) < 1e-3, (got, ref)
+
+
+def test_pipeline_moe_train_step_learns():
+    """Full fwd+bwd+adamw on a pp x ep x tp mesh: grads flow through the
+    ppermute schedule and the expert dispatch; loss decreases."""
+    from dataclasses import replace
+
+    import optax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel.context import use_mesh
+
+    cfg = replace(gpt2.GPT2_TINY, moe_experts=2, attention="dense",
+                  compute_dtype=jnp.float32)
+    params = gpt2.to_pipeline_params(
+        gpt2.init_params(jax.random.PRNGKey(0), cfg), cfg)
+    scfg = ShardingConfig(pp=2, ep=2, tp=2)
+    mesh = scfg.build_mesh()
+    params = shard_params(params, scfg, mesh)
+    opt = optax.adamw(1e-3)
+    ost = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    step = jax.jit(gpt2.make_train_step(cfg, opt, pp_microbatches=2))
+    with use_mesh(mesh):
+        p, o, m1 = step(params, ost, {"tokens": tokens})
+        _, _, m2 = step(p, o, {"tokens": tokens})
+    assert float(m2["loss"]) < float(m1["loss"])

@@ -1,0 +1,119 @@
+"""Reference counting + lineage reconstruction (reference:
+`src/ray/core_worker/reference_count.h:61`,
+`object_recovery_manager.h:41`)."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.worker import global_worker
+
+
+@pytest.fixture
+def small_store():
+    """64MB store + fast release grace so eviction/free paths trigger."""
+    from ray_tpu.core.config import config
+
+    old = config.ref_free_grace_s
+    config.ref_free_grace_s = 0.3
+    ray_tpu.init(num_cpus=2, object_store_memory=64 << 20)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    config.ref_free_grace_s = old
+
+
+def test_store_and_metadata_bounded_without_free(small_store):
+    """Creating many times the store capacity with refs dropped runs with
+    bounded store usage AND bounded raylet metadata — no manual free()."""
+    w = global_worker()
+    for i in range(20):  # 20 x 16MB through a 64MB store
+        ref = ray_tpu.put(np.full(4 << 20, i, np.int32))
+        assert int(ray_tpu.get(ref)[0]) == i
+        del ref
+        gc.collect()
+        time.sleep(0.05)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        stats = w.store.stats()
+        n_meta = w.raylet.call(lambda: len(w.raylet._objects)).result()
+        if stats["bytes_in_use"] < 50 << 20 and n_meta < 30:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"unbounded: {stats} meta={n_meta}")
+
+
+def test_task_results_release_on_ref_drop(small_store):
+    @ray_tpu.remote
+    def blob(i):
+        return np.full(4 << 20, i, np.int32)  # 16MB
+
+    for i in range(12):
+        assert int(ray_tpu.get(blob.remote(i), timeout=60)[0]) == i
+        gc.collect()
+    w = global_worker()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if w.store.stats()["bytes_in_use"] < 50 << 20:
+            return
+        time.sleep(0.2)
+    raise AssertionError(w.store.stats())
+
+
+def test_evicted_intermediate_reconstructs(small_store):
+    @ray_tpu.remote
+    def make(i):
+        return np.full(3 << 20, i, np.int32)  # 12MB
+
+    early = make.remote(7)
+    assert int(ray_tpu.get(early, timeout=60)[0]) == 7
+    # pressure evicts it (held refs keep the new objects pinned)
+    hold = [ray_tpu.put(np.full(3 << 20, 99, np.int32)) for _ in range(4)]
+    val = ray_tpu.get(early, timeout=60)  # transparently re-executed
+    assert int(val[0]) == 7
+    del hold
+
+
+def test_lineage_chain_reconstructs(small_store):
+    """The evicted object's DEPENDENCY was also evicted: recovery recurses
+    through the lineage."""
+
+    @ray_tpu.remote
+    def base():
+        return np.full(3 << 20, 5, np.int32)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    assert int(ray_tpu.get(d, timeout=60)[0]) == 10
+    hold = [ray_tpu.put(np.full(3 << 20, 99, np.int32)) for _ in range(4)]
+    assert int(ray_tpu.get(d, timeout=60)[0]) == 10
+    del hold
+
+
+def test_held_task_result_survives_pressure(small_store):
+    """A TASK result whose ref is held stays gettable through eviction
+    pressure (reconstruction backs it).  put() objects have no lineage —
+    keeping them through pressure needs primary-copy pinning + spilling
+    (reference: `local_object_manager.h:41`), not yet built."""
+
+    @ray_tpu.remote
+    def make():
+        return np.arange(1 << 20, dtype=np.int64)  # 8MB
+
+    ref = make.remote()
+    assert ray_tpu.get(ref, timeout=60).shape == (1 << 20,)
+
+    @ray_tpu.remote
+    def churn(i):
+        return np.full(3 << 20, i, np.int32)
+
+    for i in range(6):
+        ray_tpu.get(churn.remote(i), timeout=60)
+    got = ray_tpu.get(ref, timeout=60)
+    assert got.shape == (1 << 20,)
