@@ -14,7 +14,11 @@ from typing import Any, Dict, Optional
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, TaskID
-from ray_tpu.core.remote_function import _build_resources, _placement_from_opts
+from ray_tpu.core.remote_function import (
+    _build_resources,
+    _placement_from_opts,
+    _prepare_env,
+)
 from ray_tpu.core.task_spec import (
     ACTOR_CREATION_TASK,
     ACTOR_TASK,
@@ -140,7 +144,7 @@ class ActorClass:
             max_restarts=max_restarts,
             max_concurrency=opts.get("max_concurrency", 1),
             actor_id=actor_id,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=placement or None,
         )
         worker.submit_spec(spec)

@@ -24,10 +24,16 @@ import weakref
 from typing import Any, Optional
 
 from ray_tpu.core import serialization
+from ray_tpu.core.config import config
 from ray_tpu.core.ids import ObjectID
 
 
 from ray_tpu.core.exceptions import ObjectLostError as _BaseObjectLostError
+
+config.define("object_store_spill", bool, True,
+              "Overflowing puts spill to disk (reference: "
+              "local_object_manager.h:41) instead of LRU-evicting sealed "
+              "objects; False restores pure in-memory LRU behavior.")
 
 
 class ObjectStoreFullError(RuntimeError):
@@ -67,6 +73,11 @@ def _load_lib():
     lib.rt_create.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_create_opts.restype = ctypes.c_int
+    lib.rt_create_opts.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
     ]
     lib.rt_seal.restype = ctypes.c_int
     lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -131,9 +142,17 @@ def create_store_file(path: str, capacity_bytes: int, table_cap: int = 1 << 16):
 
 
 class ShmObjectStore:
-    """A client connection (attach) to a shm store file."""
+    """A client connection (attach) to a shm store file.
 
-    def __init__(self, path: str):
+    Overflow spilling (reference: `src/ray/raylet/local_object_manager.h:41`
+    ``SpillObjectUptoMaxThroughput``): when the arena cannot fit a new
+    object, its bytes go to a per-store spill DIRECTORY on disk and reads
+    restore them transparently (mmap + zero-copy deserialize).  The
+    serverless-store design moves spilling into the writing client — no
+    IO-worker processes — with the spill dir shared by every client of
+    the store file."""
+
+    def __init__(self, path: str, spill_dir: Optional[str] = None):
         self._path = path
         self._lib = _get_lib()
         self._handle = self._lib.rt_store_attach(path.encode())
@@ -146,12 +165,65 @@ class ShmObjectStore:
         finally:
             os.close(fd)
         self._view = memoryview(self._mmap)
+        self._spill_dir = spill_dir or (path + ".spill")
+
+    # -- spill plane ----------------------------------------------------------
+
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def spill(self, object_id: ObjectID, ser: "serialization.SerializedObject"):
+        """Write a serialized object to the spill dir (atomic rename)."""
+        buf = bytearray(ser.total_bytes())
+        ser.write_into(memoryview(buf))
+        self.spill_raw(object_id, buf)
+
+    def spill_raw(self, object_id: ObjectID, data):
+        os.makedirs(self._spill_dir, exist_ok=True)
+        tmp = self._spill_path(object_id) + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._spill_path(object_id))
+
+    def has_spilled(self, object_id: ObjectID) -> bool:
+        return os.path.exists(self._spill_path(object_id))
+
+    def read_spilled(self, object_id: ObjectID):
+        """Deserialize straight off a file mapping (buffers alias the map;
+        the finalizer keeps it alive like the shm path does)."""
+        import weakref
+
+        try:
+            fd = os.open(self._spill_path(object_id), os.O_RDONLY)
+        except OSError:
+            # raced a free()/delete() between has_spilled and open
+            raise ObjectLostError(object_id) from None
+        try:
+            m = _mmap.mmap(fd, 0, prot=_mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        value = serialization.deserialize(memoryview(m))
+
+        def _close(mm=m):
+            try:
+                mm.close()
+            except BufferError:
+                pass  # a view still aliases the map (interpreter exit)
+
+        try:
+            weakref.finalize(value, _close)
+        except TypeError:
+            pass  # scalar/container: mapping lives until GC of m
+        return value
 
     # -- raw byte-level API ---------------------------------------------------
 
-    def create(self, object_id: ObjectID, size: int) -> memoryview:
+    def create(self, object_id: ObjectID, size: int,
+               allow_evict: bool = True) -> memoryview:
         off = ctypes.c_uint64()
-        rc = self._lib.rt_create(self._handle, object_id.binary(), size, ctypes.byref(off))
+        rc = self._lib.rt_create_opts(self._handle, object_id.binary(),
+                                      size, ctypes.byref(off),
+                                      1 if allow_evict else 0)
         if rc == -17:  # EEXIST
             raise FileExistsError(object_id.hex())
         if rc != 0:
@@ -183,7 +255,13 @@ class ShmObjectStore:
         return bool(self._lib.rt_contains(self._handle, object_id.binary()))
 
     def delete(self, object_id: ObjectID) -> bool:
-        return self._lib.rt_delete(self._handle, object_id.binary()) == 0
+        ok = self._lib.rt_delete(self._handle, object_id.binary()) == 0
+        try:
+            os.unlink(self._spill_path(object_id))
+            ok = True
+        except OSError:
+            pass
+        return ok
 
     def stats(self) -> dict:
         st = _StoreStats()
@@ -197,8 +275,21 @@ class ShmObjectStore:
 
     # -- object-level API -----------------------------------------------------
 
-    def put_serialized(self, object_id: ObjectID, ser: serialization.SerializedObject):
-        buf = self.create(object_id, ser.total_bytes())
+    def put_serialized(self, object_id: ObjectID,
+                       ser: serialization.SerializedObject,
+                       spill_ok: Optional[bool] = None):
+        if spill_ok is None:
+            spill_ok = config.object_store_spill
+        try:
+            # spilling mode never LRU-evicts sealed data: the NEW object
+            # overflows to disk instead (no silent loss)
+            buf = self.create(object_id, ser.total_bytes(),
+                              allow_evict=not spill_ok)
+        except ObjectStoreFullError:
+            if not spill_ok:
+                raise
+            self.spill(object_id, ser)
+            return
         try:
             ser.write_into(buf)
         except BaseException:
@@ -224,6 +315,9 @@ class ShmObjectStore:
         delay = 0.0005
         while True:
             buf = self.get_buffer(object_id)
+            if buf is None and not self.contains(object_id) \
+                    and self.has_spilled(object_id):
+                return self.read_spilled(object_id)
             if buf is None and known_sealed and not self.contains(object_id):
                 raise ObjectLostError(object_id)
             if buf is not None:

@@ -1119,6 +1119,31 @@ class Raylet:
                                "eof": True})
                     return
                 buf = store.get_buffer(oid) if store is not None else None
+                if buf is None and store is not None \
+                        and store.has_spilled(oid):
+                    # stream the spilled file from disk, chunk by chunk —
+                    # never materialize (possibly store-sized+) bytes
+                    try:
+                        f = open(store._spill_path(oid), "rb")
+                    except OSError:
+                        peer.send({"t": "pull_err", "rid": rid,
+                                   "error": f"object {oid.hex()} freed"})
+                        return
+                    with f:
+                        size = os.fstat(f.fileno()).st_size
+                        peer.send({"t": "pull_meta", "rid": rid,
+                                   "kind": "store", "size": size})
+                        chunk = config.object_transfer_chunk_bytes
+                        sent = 0
+                        while True:
+                            data = f.read(chunk)
+                            sent += len(data)
+                            eof = sent >= size or not data
+                            peer.send({"t": "chunk", "rid": rid,
+                                       "data": data, "eof": eof})
+                            if eof:
+                                break
+                    return
                 if buf is None:
                     peer.send({"t": "pull_err", "rid": rid,
                                "error": f"object {oid.hex()} not here"})
@@ -1198,7 +1223,11 @@ class Raylet:
         if msg["kind"] == "store" and msg["size"] > 0:
             store = self._raylet_store()
             try:
-                pull["mv"] = store.create(oid, msg["size"])
+                # spill mode: never evict sealed data to admit a pull;
+                # overflow lands in the spill dir at eof instead
+                pull["mv"] = store.create(
+                    oid, msg["size"],
+                    allow_evict=not config.object_store_spill)
             except FileExistsError:
                 pass  # already local (raced another pull path)
             except Exception:  # noqa: BLE001  (store full etc.)
@@ -1234,7 +1263,9 @@ class Raylet:
             store.release(oid)
         elif store is not None:
             try:
-                mv = store.create(oid, len(pull["buf"]))
+                mv = store.create(
+                    oid, len(pull["buf"]),
+                    allow_evict=not config.object_store_spill)
                 mv[:] = pull["buf"]
                 del mv
                 store.seal(oid)
@@ -1242,9 +1273,13 @@ class Raylet:
             except FileExistsError:
                 pass
             except Exception:  # noqa: BLE001
-                self._object_error(oid, ObjectLostError(
-                    f"no store capacity for pulled object {oid.hex()}"))
-                return
+                if config.object_store_spill:
+                    # no arena room: the pulled bytes overflow to disk
+                    store.spill_raw(oid, pull["buf"])
+                else:
+                    self._object_error(oid, ObjectLostError(
+                        f"no store capacity for pulled object {oid.hex()}"))
+                    return
         self._object_in_store(oid)
 
     def _handle_pull_err(self, msg: dict):

@@ -70,6 +70,9 @@ def main():
         os.unlink(store_path)
     except OSError:
         pass
+    import shutil
+
+    shutil.rmtree(store_path + ".spill", ignore_errors=True)
     return 0
 
 

@@ -21,6 +21,15 @@ def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
     return {k: v for k, v in res.items() if v}
 
 
+def _prepare_env(worker, env: Optional[dict]) -> Optional[dict]:
+    if not env or not (env.get("working_dir") or env.get("py_modules")
+                       or env.get("pip") or env.get("conda")):
+        return env
+    from ray_tpu.core.runtime_env import prepare_runtime_env
+
+    return prepare_runtime_env(worker, env)
+
+
 def _placement_from_opts(opts) -> Optional[dict]:
     strategy = opts.get("scheduling_strategy")
     if strategy is None:
@@ -80,7 +89,7 @@ class RemoteFunction:
             max_retries=max_retries,
             retries_left=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=_placement_from_opts(opts),
         )
         refs = worker.submit_spec(spec)

@@ -437,6 +437,10 @@ def _gc_stale_stores(shm_dir: str):
                     os.unlink(os.path.join(shm_dir, name))
                 except OSError:
                     pass
+                import shutil
+
+                shutil.rmtree(os.path.join(shm_dir, name + ".spill"),
+                              ignore_errors=True)
             except PermissionError:
                 pass
     except OSError:
@@ -515,6 +519,10 @@ class DriverWorker(Worker):
                 os.unlink(self.store_path)
             except OSError:
                 pass
+        if self.store_path:
+            import shutil
+
+            shutil.rmtree(self.store_path + ".spill", ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------

@@ -180,12 +180,10 @@ def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
 
 
 def _apply_runtime_env(spec: TaskSpec):
-    env = spec.runtime_env or {}
-    wd = env.get("working_dir")
-    if wd:
-        os.chdir(wd)
-        if wd not in sys.path:
-            sys.path.insert(0, wd)
+    from ray_tpu.core import runtime_env as _rtenv
+    from ray_tpu.core.worker import global_worker
+
+    _rtenv.ensure_runtime_env(global_worker(), spec.runtime_env)
 
 
 def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):

@@ -111,10 +111,14 @@ def init_params(rng, cfg: GPT2Config) -> Dict[str, Any]:
 
 
 def _layer_norm(x, p, eps=1e-5):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    y = (x - mu) * jax.lax.rsqrt(var + eps)
-    return y * p["scale"] + p["bias"]
+    """Stats in f32 for stability; output CAST BACK to the input dtype —
+    the f32 scale/bias would otherwise silently promote the residual
+    stream (and every downstream matmul) to the MXU's slow f32 path."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
 
 
 def _attention(x, p, cfg: GPT2Config, mesh=None):

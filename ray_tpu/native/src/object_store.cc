@@ -312,15 +312,19 @@ void rt_store_detach(void* handle) {
 
 // Create an object buffer of `size` bytes.  Writes the data offset (from file
 // start) into *out_offset.  The object is pinned (refcount 1) and unsealed.
-//  0: ok   -EEXIST: already exists   -ENOMEM: no space even after eviction
-int rt_create(void* handle, const uint8_t* key, uint64_t size,
-              uint64_t* out_offset) {
+// `allow_evict` = 0 disables LRU eviction: the caller prefers failing (and
+// spilling the NEW object to disk) over silently dropping sealed data.
+//  0: ok   -EEXIST: already exists   -ENOMEM: no space (even after eviction)
+int rt_create_opts(void* handle, const uint8_t* key, uint64_t size,
+                   uint64_t* out_offset, int allow_evict) {
   Store* s = static_cast<Store*>(handle);
   Guard g(s);
   Entry* existing = find_entry(s, key);
   if (existing && existing->state != kTombstone) return -EEXIST;
   uint64_t want = size ? size : 1;
-  if (!evict_for(s, align_up(want))) return -ENOMEM;
+  if (allow_evict) {
+    if (!evict_for(s, align_up(want))) return -ENOMEM;
+  }
   uint64_t off = heap_alloc(s, want);
   if (!off) return -ENOMEM;
   Entry* e = find_slot(s, key);
@@ -337,6 +341,11 @@ int rt_create(void* handle, const uint8_t* key, uint64_t size,
   s->hdr->num_objects++;
   *out_offset = off;
   return 0;
+}
+
+int rt_create(void* handle, const uint8_t* key, uint64_t size,
+              uint64_t* out_offset) {
+  return rt_create_opts(handle, key, size, out_offset, 1);
 }
 
 int rt_seal(void* handle, const uint8_t* key) {

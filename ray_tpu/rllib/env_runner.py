@@ -67,7 +67,11 @@ class EnvRunner:
         self._key = jax.random.PRNGKey(seed)
         self._sample_action = jax.jit(sample_action)
         obs, _ = self._envs.reset(seed=seed)
-        self._obs = np.asarray(obs, np.float32)
+        # keep the env's native dtype: uint8 pixels stay uint8 (the CNN
+        # normalizes /255 itself); float envs stay float32
+        self._obs = np.asarray(obs)
+        if self._obs.dtype != np.uint8:
+            self._obs = self._obs.astype(np.float32)
         # per-env running episode returns (for episode_reward metrics)
         self._ep_return = np.zeros(num_envs, np.float64)
         self._ep_len = np.zeros(num_envs, np.int64)
@@ -85,7 +89,7 @@ class EnvRunner:
 
         assert self._params is not None, "set_weights before sample"
         T, B = self._T, self._num_envs
-        obs_buf = np.empty((T, B) + self._obs.shape[1:], np.float32)
+        obs_buf = np.empty((T, B) + self._obs.shape[1:], self._obs.dtype)
         act_buf = np.empty((T, B), np.int64)
         logp_buf = np.empty((T, B), np.float32)
         val_buf = np.empty((T, B), np.float32)
@@ -117,14 +121,18 @@ class EnvRunner:
                     (float(self._ep_return[i]), int(self._ep_len[i])))
                 self._ep_return[i] = 0.0
                 self._ep_len[i] = 0
-            self._obs = np.asarray(next_obs, np.float32)
+            self._obs = np.asarray(next_obs)
+            if self._obs.dtype != np.uint8:
+                self._obs = self._obs.astype(np.float32)
 
         # bootstrap value for the final observation of each env
         self._key, sub = jax.random.split(self._key)
         _, _, last_value = self._sample_action(self._params, self._obs, sub)
 
         batch = SampleBatch({
-            OBS: obs_buf.reshape(T * B, -1),
+            # keep the native obs shape (CNN policies need (H, W, C));
+            # MLP forward flattens for itself
+            OBS: obs_buf.reshape((T * B,) + obs_buf.shape[2:]),
             ACTIONS: act_buf.reshape(T * B),
             LOGPS: logp_buf.reshape(T * B),
             VALUES: val_buf.reshape(T * B),

@@ -1,0 +1,219 @@
+"""IMPALA — asynchronous actor-learner with V-trace off-policy correction.
+
+Reference analogue: `rllib/algorithms/impala/impala.py:68` (async rollout
+queue feeding a learner, `:552` training_step) and the V-trace math from
+`rllib/algorithms/impala/vtrace_*.py` (Espeholt et al. 2018, re-derived
+here from the paper's recurrence, not ported).
+
+TPU-first shape: env runners sample CONTINUOUSLY — the learner never
+blocks on the slowest runner; each training_step consumes whatever
+rollouts are ready (re-issuing sample() on the freed runners immediately)
+and runs ONE jitted V-trace update per gathered batch.  Stale-policy
+drift between the behavior policy (runner weights) and the target policy
+(learner weights) is exactly what the rho/c clipping corrects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vtrace_rho_bar = 1.0
+        self.vtrace_c_bar = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.hidden = (64, 64)
+        self.cnn = False  # Nature-CNN torso for (H, W, C) pixel obs
+        self.max_inflight_per_runner = 1
+
+    def build(self) -> "Impala":
+        return Impala(self)
+
+
+def make_vtrace_fn():
+    """Returns vtrace(target_logps, behavior_logps, rewards, dones, values,
+    bootstrap, gamma, rho_bar, c_bar) -> (vs, pg_adv), all time-major
+    (T, B).  Reverse lax.scan of the V-trace recurrence:
+
+        vs_t = V(x_t) + dt_t + gamma_t * c_t * (vs_{t+1} - V(x_{t+1}))
+        dt_t = rho_t (r_t + gamma_t V(x_{t+1}) - V(x_t))
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def vtrace(target_logps, behavior_logps, rewards, dones, values,
+               bootstrap, gamma, rho_bar, c_bar):
+        rhos = jnp.exp(target_logps - behavior_logps)
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        clipped_c = jnp.minimum(c_bar, rhos)
+        discounts = gamma * (1.0 - dones)
+        next_values = jnp.concatenate(
+            [values[1:], bootstrap[None]], axis=0)
+        deltas = clipped_rho * (rewards + discounts * next_values - values)
+
+        def body(carry, xs):
+            delta_t, disc_t, c_t = xs
+            carry = delta_t + disc_t * c_t * carry
+            return carry, carry
+
+        _, dvs = jax.lax.scan(
+            body, jnp.zeros_like(bootstrap),
+            (deltas, discounts, clipped_c), reverse=True)
+        vs = values + dvs
+        next_vs = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+        pg_adv = clipped_rho * (rewards + discounts * next_vs - values)
+        return vs, pg_adv
+
+    return vtrace
+
+
+def _make_update_fn(cfg: ImpalaConfig, optimizer):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import policy_forward
+
+    vtrace = make_vtrace_fn()
+
+    def loss_fn(params, batch):
+        # batch arrays are time-major (T, B, ...)
+        T, B = batch[REWARDS].shape
+        obs = batch[OBS].reshape((T * B,) + batch[OBS].shape[2:])
+        logits, values = policy_forward(params, obs)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logps = jnp.take_along_axis(
+            logp_all, batch[ACTIONS][..., None], axis=-1)[..., 0]
+        vs, pg_adv = vtrace(
+            jax.lax.stop_gradient(target_logps), batch[LOGPS],
+            batch[REWARDS], batch[DONES], jax.lax.stop_gradient(values),
+            batch["bootstrap"], cfg.gamma, cfg.vtrace_rho_bar,
+            cfg.vtrace_c_bar)
+        pg_loss = -jnp.mean(target_logps * jax.lax.stop_gradient(pg_adv))
+        vf_loss = 0.5 * jnp.mean(
+            jnp.square(values - jax.lax.stop_gradient(vs)))
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        if cfg.grad_clip:
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                 for g in jax.tree.leaves(grads)))
+            scale = jnp.minimum(1.0, cfg.grad_clip / (gnorm + 1e-8))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, metrics
+
+    return update
+
+
+class Impala(Algorithm):
+    _config_cls = ImpalaConfig
+
+    def build_learner(self):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.models import init_cnn_policy, init_mlp_policy
+
+        cfg: ImpalaConfig = self.algo_config
+        probe_env = cfg.env_creator()
+        num_actions = int(probe_env.action_space.n)
+        obs_shape = probe_env.observation_space.shape
+        probe_env.close()
+        key = jax.random.PRNGKey(cfg.seed)
+        if cfg.cnn:
+            self._params = init_cnn_policy(key, obs_shape, num_actions)
+        else:
+            self._params = init_mlp_policy(
+                key, int(np.prod(obs_shape)), num_actions, cfg.hidden)
+        self._optimizer = optax.rmsprop(cfg.lr, decay=0.99, eps=0.1)
+        self._opt_state = self._optimizer.init(self._params)
+        self._update = _make_update_fn(cfg, self._optimizer)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self._params)
+
+    def set_weights(self, weights):
+        self._params = weights
+
+    def _ensure_sampling(self):
+        """Keep every runner busy (the async pipeline of the reference's
+        rollout queue)."""
+        busy = set(self._inflight.values())
+        for r in self.env_runners:
+            if r not in busy:
+                self._inflight[r.sample.remote()] = r
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg: ImpalaConfig = self.algo_config
+        self._ensure_sampling()
+        # consume whatever is ready (at least one)
+        refs = list(self._inflight.keys())
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=120)
+        # opportunistically grab more finished rollouts
+        more, _ = ray_tpu.wait(
+            [r for r in refs if r not in ready],
+            num_returns=max(1, len(refs) - 1), timeout=0) \
+            if len(refs) > 1 else ([], None)
+        metrics = {}
+        steps = 0
+        for ref in list(ready) + list(more):
+            runner = self._inflight.pop(ref)
+            ro = ray_tpu.get(ref)
+            self._total_env_steps += ro["metrics"]["env_steps"]
+            self._episode_returns.extend(
+                ep[0] for ep in ro["metrics"]["episodes"])
+            b: SampleBatch = ro["batch"]
+            T, B = ro["t_shape"]
+            tm = {
+                OBS: b[OBS].reshape((T, B) + b[OBS].shape[1:]),
+                ACTIONS: b[ACTIONS].reshape(T, B),
+                LOGPS: b[LOGPS].reshape(T, B),
+                REWARDS: b[REWARDS].reshape(T, B).astype(np.float32),
+                DONES: b[DONES].reshape(T, B).astype(np.float32),
+                "bootstrap": ro["last_values"].astype(np.float32),
+            }
+            self._params, self._opt_state, m = self._update(
+                self._params, self._opt_state, tm)
+            metrics = {k: float(v) for k, v in m.items()}
+            steps += T * B
+            # restart sampling on the freed runner with FRESH weights
+            runner.set_weights.remote(self.get_weights())
+            self._inflight[runner.sample.remote()] = runner
+        metrics["_steps_this_iter"] = steps
+        metrics["num_inflight"] = len(self._inflight)
+        return metrics
+
+    def synchronous_parallel_sample(self):  # not used by IMPALA
+        raise NotImplementedError

@@ -40,8 +40,8 @@ def init_mlp_policy(rng, obs_dim: int, num_actions: int,
 
 
 def mlp_forward(params, obs):
-    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
-    x = obs
+    """obs (B, ...) -> (logits (B, A), value (B,)); trailing dims flatten."""
+    x = obs.reshape(obs.shape[0], -1)
     i = 0
     while f"fc_{i}" in params:
         p = params[f"fc_{i}"]
@@ -52,9 +52,79 @@ def mlp_forward(params, obs):
     return logits, value
 
 
+def init_cnn_policy(rng, obs_shape, num_actions: int,
+                    channels=(32, 64, 64), dense: int = 512):
+    """Nature-CNN torso for pixel observations (reference:
+    `rllib/models/torch/visionnet.py` / the Atari defaults in
+    `rllib/models/catalog.py`): conv 8x8/4, 4x4/2, 3x3/1 -> dense ->
+    categorical + value heads.  obs_shape = (H, W, C)."""
+    H, W, C = obs_shape
+    keys = jax.random.split(rng, 6)
+    specs = [(8, 4, C, channels[0]), (4, 2, channels[0], channels[1]),
+             (3, 1, channels[1], channels[2])]
+    params = {}
+    h, w = H, W
+    for i, (k, s, cin, cout) in enumerate(specs):
+        fan_in = k * k * cin
+        params[f"conv_{i}"] = {
+            "w": jax.random.normal(keys[i], (k, k, cin, cout), jnp.float32)
+            * jnp.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((cout,)),
+        }
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+    flat = h * w * channels[-1]
+    params["fc"] = {
+        "w": jax.random.normal(keys[3], (flat, dense), jnp.float32)
+        * jnp.sqrt(2.0 / flat),
+        "b": jnp.zeros((dense,)),
+    }
+    params["pi"] = {
+        "w": jax.random.normal(keys[4], (dense, num_actions),
+                               jnp.float32) * 0.01,
+        "b": jnp.zeros((num_actions,)),
+    }
+    params["vf"] = {
+        "w": jax.random.normal(keys[5], (dense, 1), jnp.float32),
+        "b": jnp.zeros((1,)),
+    }
+    return params
+
+
+def cnn_forward(params, obs):
+    """obs (B, H, W, C) uint8/float -> (logits, value).  bf16-friendly:
+    convs lower to MXU convolutions on TPU."""
+    x = obs.astype(jnp.float32)
+    if obs.dtype == jnp.uint8:
+        x = x / 255.0
+    _stride_for_kernel = {8: 4, 4: 2, 3: 1}  # Nature-CNN pairings
+    i = 0
+    while f"conv_{i}" in params:
+        p = params[f"conv_{i}"]
+        s = _stride_for_kernel[p["w"].shape[0]]
+        x = jax.lax.conv_general_dilated(
+            x, p["w"], window_strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + p["b"])
+        i += 1
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc"]["w"] + params["fc"]["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def policy_forward(params, obs):
+    """Dispatch on the param structure: CNN torso when conv layers are
+    present, MLP otherwise."""
+    if "conv_0" in params:
+        return cnn_forward(params, obs)
+    return mlp_forward(params, obs)
+
+
 def sample_action(params, obs, key):
     """Returns (action, logp, value) for a batch of observations."""
-    logits, value = mlp_forward(params, obs)
+    logits, value = policy_forward(params, obs)
     action = jax.random.categorical(key, logits, axis=-1)
     logp = jax.nn.log_softmax(logits)[
         jnp.arange(action.shape[0]), action]

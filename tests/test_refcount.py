@@ -14,15 +14,24 @@ from ray_tpu.core.worker import global_worker
 
 @pytest.fixture
 def small_store():
-    """64MB store + fast release grace so eviction/free paths trigger."""
+    """64MB store + fast release grace so eviction/free paths trigger;
+    spilling disabled so LRU eviction (the reconstruction trigger) is
+    actually exercised."""
     from ray_tpu.core.config import config
 
+    import os
+
     old = config.ref_free_grace_s
+    old_spill = config.object_store_spill
     config.ref_free_grace_s = 0.3
+    config.object_store_spill = False
+    os.environ["RAY_TPU_OBJECT_STORE_SPILL"] = "0"  # workers inherit
     ray_tpu.init(num_cpus=2, object_store_memory=64 << 20)
     yield ray_tpu
     ray_tpu.shutdown()
     config.ref_free_grace_s = old
+    config.object_store_spill = old_spill
+    os.environ.pop("RAY_TPU_OBJECT_STORE_SPILL", None)
 
 
 def test_store_and_metadata_bounded_without_free(small_store):

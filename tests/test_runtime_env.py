@@ -1,0 +1,57 @@
+"""Runtime environments: working_dir / py_modules packaging through the
+GCS KV (reference: `python/ray/_private/runtime_env/{packaging,
+working_dir,py_modules}.py`)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+def test_working_dir_ships_files(ray_shared, tmp_path):
+    (tmp_path / "data.txt").write_text("hello from the driver")
+    (tmp_path / "helper.py").write_text("MAGIC = 41\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_back():
+        import helper  # importable: working_dir is on sys.path
+
+        with open("data.txt") as f:
+            return f.read(), helper.MAGIC + 1, os.getcwd()
+
+    text, magic, cwd = ray_tpu.get(read_back.remote(), timeout=60)
+    assert text == "hello from the driver"
+    assert magic == 42
+    assert str(tmp_path) not in cwd  # ran from the extracted cache copy
+
+
+def test_py_modules_importable(ray_shared, tmp_path):
+    pkg = tmp_path / "mylib"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("def f():\n    return 'from mylib'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_lib():
+        import mylib
+
+        return mylib.f()
+
+    assert ray_tpu.get(use_lib.remote(), timeout=60) == "from mylib"
+
+
+def test_pip_rejected(ray_shared):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def nope():
+        return 1
+
+    with pytest.raises(ValueError, match="hermetic"):
+        nope.remote()
+
+
+def test_env_vars_still_work(ray_shared):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    def read_env():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "on"
