@@ -5,7 +5,8 @@ Reference analogue: `python/ray/data/__init__.py`.  See
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
+                                  GroupedData)
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
     from_arrow,
@@ -21,7 +22,8 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
-    "Block", "BlockAccessor", "BlockMetadata", "Dataset", "DataIterator",
+    "ActorPoolStrategy", "Block", "BlockAccessor", "BlockMetadata",
+    "Dataset", "DataIterator", "GroupedData",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files",

@@ -33,18 +33,34 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, VALUE_COL
 # Lazy op chain
 
 
+class ActorPoolStrategy:
+    """compute= strategy for map_batches: run the UDF in a pool of
+    long-lived actors instead of stateless tasks (reference:
+    `_internal/execution/operators/actor_pool_map_operator.py`) — for
+    stateful/expensive-setup UDFs (model inference)."""
+
+    def __init__(self, size: int = 2, num_cpus: float = 1,
+                 num_tpus: float = 0):
+        self.size = size
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+
+
 class _OpSpec:
     """One logical transform; a chain of these fuses into one task."""
 
-    __slots__ = ("kind", "fn", "batch_size", "batch_format", "fn_kwargs")
+    __slots__ = ("kind", "fn", "batch_size", "batch_format", "fn_kwargs",
+                 "compute")
 
     def __init__(self, kind: str, fn: Callable, batch_size=None,
-                 batch_format: str = "numpy", fn_kwargs: Optional[dict] = None):
+                 batch_format: str = "numpy", fn_kwargs: Optional[dict] = None,
+                 compute: Optional[ActorPoolStrategy] = None):
         self.kind = kind
         self.fn = fn
         self.batch_size = batch_size
         self.batch_format = batch_format
         self.fn_kwargs = fn_kwargs or {}
+        self.compute = compute
 
     def __repr__(self):
         return f"_OpSpec({self.kind}, {getattr(self.fn, '__name__', self.fn)})"
@@ -105,6 +121,76 @@ def _slice_task(block: Block, start: int, end: int):
 
 def _concat_task(*blocks: Block):
     out = BlockAccessor.concat(list(blocks))
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+def _zip_task(b1: Block, b2: Block):
+    a1, a2 = BlockAccessor.for_block(b1), BlockAccessor.for_block(b2)
+    if a1.num_rows() != a2.num_rows():
+        raise ValueError(
+            f"zip: block row counts differ ({a1.num_rows()} vs "
+            f"{a2.num_rows()})")
+    if isinstance(b1, dict) and isinstance(b2, dict):
+        out = dict(b1)
+        for k, v in b2.items():
+            name = k
+            i = 1
+            while name in out:  # find a free suffix, never clobber
+                name = f"{k}_{i}"
+                i += 1
+            out[name] = v
+    else:
+        out = [(r1, r2) for r1, r2 in zip(a1.iter_rows(), a2.iter_rows())]
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+def _stable_hash(k) -> int:
+    """Process-independent key hash: Python's str hashing is randomized
+    per process, which would scatter one key across partitions when each
+    block partitions in a different worker."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.md5(str(k).encode()).digest()[:8], "little")
+
+
+def _hash_partition_task(block: Block, key, n_parts: int):
+    """Split a block into n_parts by hash(key) — one RETURN PER PART
+    (num_returns=n_parts), so each downstream group task ships only its
+    own partition, not the whole dataset."""
+    acc = BlockAccessor.for_block(block)
+    buckets: List[List[Any]] = [[] for _ in range(n_parts)]
+    for row in acc.iter_rows():
+        k = row[key] if not callable(key) else key(row)
+        buckets[_stable_hash(k) % n_parts].append(row)
+    blocks = [BlockAccessor.rows_to_block(rows) for rows in buckets]
+    return blocks[0] if n_parts == 1 else blocks
+
+
+def _group_apply_task(key, fn, batch_format: str, *parts):
+    """Gather one hash partition from every block, group rows by key, and
+    apply ``fn`` per group (reference: map_groups)."""
+    rows: List[Any] = []
+    for part in parts:
+        rows.extend(BlockAccessor.for_block(part).iter_rows())
+    keyfn = key if callable(key) else (lambda r: r[key])
+    groups: dict = {}
+    for row in rows:
+        groups.setdefault(keyfn(row), []).append(row)
+    outs = []
+    for k in sorted(groups, key=lambda x: (str(type(x)), x)):
+        if batch_format == "rows":
+            gbatch = groups[k]
+        else:
+            gblock = BlockAccessor.rows_to_block(groups[k])
+            gbatch = BlockAccessor.for_block(gblock).to_batch(batch_format)
+        res = fn(gbatch)
+        outs.append(res if isinstance(res, list)
+                    else BlockAccessor.batch_to_block(res))
+    outs = [BlockAccessor.rows_to_block(o) if isinstance(o, list) else o
+            for o in outs]
+    out = (BlockAccessor.concat(outs) if outs
+           else BlockAccessor.rows_to_block([]))
     return out, BlockAccessor.for_block(out).metadata()
 
 
@@ -209,14 +295,71 @@ class _Source:
         self.read_fn = read_fn
 
 
+class _MapWorker:
+    """Actor hosting the actor-compute suffix of an op chain; class UDFs
+    instantiate once here (reference: ActorPoolMapOperator's workers)."""
+
+    def __init__(self, ops: List[_OpSpec]):
+        self._ops = []
+        for op in ops:
+            if isinstance(op.fn, type):
+                op = _OpSpec(op.kind, op.fn(), op.batch_size,
+                             op.batch_format, op.fn_kwargs)
+            self._ops.append(op)
+
+    def apply(self, block: Block):
+        out = _apply_ops(block, self._ops)
+        return out, BlockAccessor.for_block(out).metadata()
+
+
+def _actor_stage(block_iter, actor_ops: List[_OpSpec],
+                 strategy: "ActorPoolStrategy", window: int):
+    """Pipe (ref, meta_ref) pairs through a round-robin actor pool."""
+    import itertools as _it
+
+    worker_cls = ray_tpu.remote(
+        num_cpus=strategy.num_cpus, num_tpus=strategy.num_tpus,
+        max_restarts=1)(_MapWorker)
+    actors = [worker_cls.remote(actor_ops) for _ in range(strategy.size)]
+    rr = _it.cycle(actors)
+    inflight: deque = deque()
+    try:
+        for ref, _meta in block_iter:
+            while len(inflight) >= window:
+                yield inflight.popleft()
+            out = next(rr).apply.options(num_returns=2).remote(ref)
+            inflight.append(tuple(out))
+        while inflight:
+            yield inflight.popleft()
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 def _stream_blocks(sources: List[_Source], ops: List[_OpSpec],
                    window: int = DEFAULT_WINDOW
                    ) -> Iterator[Tuple[Any, Any]]:
     """Run the fused op chain over blocks with at most ``window`` tasks in
     flight; yields (block_ref, meta_ref) in input order as tasks finish.
+    Ops from the first actor-compute op onward run in an actor pool stage.
 
     Reference analogue: `streaming_executor.py:49` — bounded, pull-based.
     """
+    compute_idx = [i for i, op in enumerate(ops) if op.compute is not None]
+    if compute_idx:
+        # pipeline of stages: each actor-compute op starts its OWN pool
+        # (with its own size/resources); following compute-less ops fuse
+        # into that stage until the next compute op
+        first = compute_idx[0]
+        it = _stream_blocks(sources, ops[:first], window)
+        bounds = compute_idx + [len(ops)]
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            it = _actor_stage(it, ops[a:b], ops[a].compute, window)
+        yield from it
+        return
     map_remote = _remote(_map_block_task, num_returns=2)
     read_remote = _remote(_read_task, num_returns=2)
     pending: deque = deque()
@@ -293,10 +436,19 @@ class Dataset:
         return Dataset(self._sources, self._ops + [op])
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    batch_format: str = "numpy", **fn_kwargs) -> "Dataset":
-        """Apply ``fn`` to batches (reference: `dataset.py:385`)."""
+                    batch_format: str = "numpy",
+                    compute: Optional[ActorPoolStrategy] = None,
+                    **fn_kwargs) -> "Dataset":
+        """Apply ``fn`` to batches (reference: `dataset.py:385`).  With
+        ``compute=ActorPoolStrategy(...)`` the UDF runs in a pool of
+        actors; ``fn`` may then be a CLASS (instantiated once per actor —
+        the stateful-inference pattern)."""
+        if isinstance(fn, type) and compute is None:
+            raise ValueError(
+                "class UDFs need compute=ActorPoolStrategy(...) — the "
+                "instance lives in the pool actors")
         return self._with_op(_OpSpec("map_batches", fn, batch_size,
-                                     batch_format, fn_kwargs))
+                                     batch_format, fn_kwargs, compute))
 
     def map(self, fn: Callable, **fn_kwargs) -> "Dataset":
         return self._with_op(_OpSpec("map", fn, fn_kwargs=fn_kwargs))
@@ -666,6 +818,88 @@ class Dataset:
                 break
         return Dataset.from_block_refs(refs, metas)
 
+    # ------------------------------------------------------------ combine
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (reference: `dataset.py` ``union``)."""
+        parts = [self.materialize()] + [o.materialize() for o in others]
+        sources = [s for d in parts for s in d._sources]
+        metas = [m for d in parts for m in d._metas]
+        return Dataset(sources, metas=metas)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned combine (reference ``zip``): dict blocks merge
+        columns (suffix `_1` on collision), row blocks become tuples.
+        The right side is re-sliced to the left side's block boundaries."""
+        left = self.materialize()
+        right = other.materialize()
+        n_left = sum(eb.meta().num_rows for eb in left._stream())
+        n_right = sum(eb.meta().num_rows for eb in right._stream())
+        if n_left != n_right:
+            raise ValueError(
+                f"zip: datasets have different row counts "
+                f"({n_left} vs {n_right})")
+        right = right.repartition_like(left)
+        zip_remote = _remote(_zip_task, num_returns=2)
+        refs, meta_refs = [], []
+        for l, r in zip(left._sources, right._sources):
+            br, mr = zip_remote.remote(l.ref, r.ref)
+            refs.append(br)
+            meta_refs.append(mr)
+        return Dataset.from_block_refs(
+            refs, ray_tpu.get(meta_refs) if meta_refs else [])
+
+    def repartition_like(self, other: "Dataset") -> "Dataset":
+        """Re-slice into the same per-block row counts as ``other``."""
+        me = self.materialize()
+        target = [eb.meta().num_rows for eb in other.materialize()._stream()]
+        mine = [eb.meta().num_rows for eb in me._stream()]
+        if sum(target) != sum(mine):
+            raise ValueError(
+                f"repartition_like: row counts differ "
+                f"({sum(mine)} vs {sum(target)})")
+        if target == mine:
+            return me
+        slice_remote = _remote(_slice_task, num_returns=2)
+        concat_remote = _remote(_concat_task, num_returns=2)
+        pieces: deque = deque()  # (ref, rows_remaining, offset)
+        for s, n in zip(me._sources, mine):
+            pieces.append([s.ref, n, 0])
+        refs, metas = [], []
+        for want in target:
+            got = 0
+            segs = []
+            while got < want:
+                ref, n, off = pieces[0]
+                take = min(want - got, n - off)
+                r, _m = slice_remote.remote(ref, off, off + take)
+                segs.append(r)
+                got += take
+                pieces[0][2] += take
+                if pieces[0][2] >= n:
+                    pieces.popleft()
+            if len(segs) == 1:
+                br, mr = segs[0], None
+            else:
+                br, mr = concat_remote.remote(*segs)
+            refs.append(br)
+            metas.append(mr)
+        fetched = ray_tpu.get([m for m in metas if m is not None]) \
+            if any(m is not None for m in metas) else []
+        out_metas, fi = [], 0
+        for m in metas:
+            if m is None:
+                out_metas.append(None)
+            else:
+                out_metas.append(fetched[fi])
+                fi += 1
+        return Dataset.from_block_refs(refs, out_metas)
+
+    def groupby(self, key) -> "GroupedData":
+        """Group rows by a column name (dict blocks) or key callable
+        (reference: `dataset.py` ``groupby`` -> GroupedData)."""
+        return GroupedData(self.materialize(), key)
+
     # ------------------------------------------------------------ aggregates
 
     def _aggregate(self, kind: str, on: Optional[str]):
@@ -736,3 +970,72 @@ class Dataset:
     def __repr__(self):
         pend = f", pending_ops={len(self._ops)}" if self._ops else ""
         return f"Dataset(num_blocks={len(self._sources)}{pend})"
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby`` (reference: `grouped_data.py`):
+    hash-partitions blocks by key, then applies per-group logic inside
+    per-partition tasks."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def map_groups(self, fn: Callable, *,
+                   batch_format: str = "numpy") -> Dataset:
+        """fn(group_batch) -> batch; groups never split across calls."""
+        ds = self._ds
+        n_parts = max(1, min(len(ds._sources), 16))
+        part_remote = _remote(_hash_partition_task, num_returns=n_parts)
+        parts = [part_remote.remote(s.ref, self._key, n_parts)
+                 for s in ds._sources]
+        if n_parts == 1:
+            parts = [[p] for p in parts]
+        apply_remote = _remote(_group_apply_task, num_returns=2)
+        refs, meta_refs = [], []
+        for j in range(n_parts):
+            r, m = apply_remote.remote(self._key, fn, batch_format,
+                                       *[p[j] for p in parts])
+            refs.append(r)
+            meta_refs.append(m)
+        return Dataset.from_block_refs(refs, ray_tpu.get(meta_refs))
+
+    def count(self) -> Dataset:
+        key = self._key
+
+        def _count(batch):
+            rows = _batch_rows(batch)
+            k = rows[0][key] if not callable(key) else key(rows[0])
+            return [{"key": k, "count": len(rows)}]
+
+        return self.map_groups(_count, batch_format="rows")
+
+    def sum(self, on: str) -> Dataset:
+        key = self._key
+        on_ = on
+
+        def _sum(batch):
+            rows = _batch_rows(batch)
+            k = rows[0][key] if not callable(key) else key(rows[0])
+            return [{"key": k, "sum": sum(r[on_] for r in rows)}]
+
+        return self.map_groups(_sum, batch_format="rows")
+
+    def mean(self, on: str) -> Dataset:
+        key = self._key
+        on_ = on
+
+        def _mean(batch):
+            rows = _batch_rows(batch)
+            k = rows[0][key] if not callable(key) else key(rows[0])
+            return [{"key": k,
+                     "mean": sum(r[on_] for r in rows) / len(rows)}]
+
+        return self.map_groups(_mean, batch_format="rows")
+
+
+def _batch_rows(batch):
+    if isinstance(batch, list):
+        return batch
+    return list(BlockAccessor.for_block(
+        BlockAccessor.batch_to_block(batch)).iter_rows())

@@ -256,3 +256,83 @@ def test_sort_all_empty_blocks(ray):
     ds = ds.union(rd.from_items([{"v": 2}], parallelism=1).filter(
         lambda r: False).materialize())
     assert ds.sort(key="v").count() == 0
+
+
+def test_map_batches_actor_pool_with_class_udf(ray_shared):
+    from ray_tpu.data import ActorPoolStrategy
+    import ray_tpu.data as rdata
+
+    class AddBase:
+        def __init__(self):
+            self.base = 100  # expensive setup happens once per actor
+
+        def __call__(self, batch):
+            return {"v": batch["v"] + self.base}
+
+    ds = rdata.from_items([{"v": i} for i in range(20)], parallelism=4)
+    out = ds.map_batches(AddBase, compute=ActorPoolStrategy(size=2),
+                         batch_size=5)
+    vals = sorted(r["v"] for r in out.take_all())
+    assert vals == [100 + i for i in range(20)]
+
+
+def test_map_batches_class_without_actors_rejected(ray_shared):
+    import ray_tpu.data as rdata
+
+    class Udf:
+        def __call__(self, b):
+            return b
+
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        rdata.range(4).map_batches(Udf)
+
+
+def test_union(ray_shared):
+    import ray_tpu.data as rdata
+
+    a = rdata.from_items([1, 2, 3])
+    b = rdata.from_items([4, 5])
+    assert sorted(a.union(b).take_all()) == [1, 2, 3, 4, 5]
+
+
+def test_zip_dict_blocks(ray_shared):
+    import ray_tpu.data as rdata
+
+    a = rdata.from_items([{"x": i} for i in range(6)], parallelism=2)
+    b = rdata.from_items([{"y": i * 10} for i in range(6)], parallelism=3)
+    rows = a.zip(b).take_all()
+    assert [(r["x"], r["y"]) for r in rows] == [(i, i * 10)
+                                               for i in range(6)]
+
+
+def test_groupby_map_groups(ray_shared):
+    import numpy as np
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"k": i % 3, "v": float(i)} for i in range(12)], parallelism=4)
+
+    def normalize(batch):
+        return {"k": batch["k"], "v": batch["v"] - batch["v"].mean()}
+
+    out = ds.groupby("k").map_groups(normalize)
+    rows = out.take_all()
+    assert len(rows) == 12
+    by_k = {}
+    for r in rows:
+        by_k.setdefault(int(r["k"]), []).append(float(r["v"]))
+    for k, vs in by_k.items():
+        assert abs(sum(vs)) < 1e-6  # centered within each group
+
+
+def test_groupby_aggregates(ray_shared):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items(
+        [{"k": "a" if i % 2 else "b", "v": i} for i in range(10)])
+    counts = {r["key"]: r["count"]
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {"a": 5, "b": 5}
+    sums = {r["key"]: r["sum"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {"a": 1 + 3 + 5 + 7 + 9, "b": 0 + 2 + 4 + 6 + 8}
