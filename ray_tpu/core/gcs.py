@@ -57,6 +57,9 @@ class GcsCore:
         # actor_id(bytes) -> {owner_node, state, name, namespace, spec_blob}
         self._actors: Dict[bytes, dict] = {}
         self._named: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
+        # cluster placement groups: pg_id -> {bundles, strategy,
+        #   assignments: {bundle_idx: node_id}, origin, pending, state}
+        self._cluster_pgs: Dict[str, dict] = {}
         # oid(hex) -> {nodes: set[node_id], size, inline}
         self._objects: Dict[str, dict] = {}
         # oid(hex) -> set of watcher node_ids (want a push when located)
@@ -143,6 +146,40 @@ class GcsCore:
             for entry in self._objects.values():
                 entry["nodes"].discard(node_id)
         self._publish("node_dead", {"node_id": node_id, "reason": reason})
+        self._repair_pgs_for_dead_node(node_id)
+
+    def _repair_pgs_for_dead_node(self, node_id: str):
+        """Re-place cluster-PG bundles that lived on a dead node onto the
+        remaining nodes (reference: GcsPlacementGroupManager reschedules
+        bundles on node failure).  Un-placeable bundles drop out of the
+        assignment table — tasks pinned to them defer until capacity
+        appears rather than forwarding to a corpse."""
+        with self._lock:
+            pgs = list(self._cluster_pgs.items())
+        for pg_id, entry in pgs:
+            affected = sorted(i for i, n in entry["assignments"].items()
+                              if n == node_id)
+            if not affected:
+                continue
+            with self._lock:
+                entry["pending"].discard(node_id)
+                for i in affected:
+                    del entry["assignments"][i]
+                entry["state"] = "reserving"
+            sub_bundles = [entry["bundles"][i] for i in affected]
+            placed = self._place_bundles(sub_bundles, entry["strategy"])
+            if placed is None:
+                continue  # keep un-assigned; retried on next node change
+            with self._lock:
+                for j, node in placed.items():
+                    entry["assignments"][affected[j]] = node
+                    entry["pending"].add(node)
+            for node in set(placed.values()):
+                sub = {affected[j]: sub_bundles[j]
+                       for j, n in placed.items() if n == node}
+                self._publish("pg_reserve",
+                              {"pg_id": pg_id, "bundles": sub},
+                              target_node=node)
 
     def start_health_monitor(self):
         if self._monitor is not None:
@@ -200,6 +237,146 @@ class GcsCore:
                     info["resources_total"].get(k, 0.0) + 1e-9 >= v
                     for k, v in resources.items())
             ]
+
+    # ----------------------------------------------------------- cluster PGs
+
+    def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
+                  strategy: str, origin_node: str) -> bool:
+        """Place each bundle on a node per the strategy and ask the
+        involved raylets (pg_reserve push) to reserve their fragments
+        (reference: GcsPlacementGroupScheduler + the 2PC bundle
+        reservation, `placement_group_resource_manager.cc`).  False =
+        infeasible against current cluster TOTALS."""
+        assignments = self._place_bundles(bundles, strategy)
+        if assignments is None:
+            return False
+        with self._lock:
+            self._cluster_pgs[pg_id] = {
+                "bundles": bundles,
+                "strategy": strategy,
+                "assignments": assignments,
+                "origin": origin_node,
+                "pending": set(assignments.values()),
+                "state": "reserving",
+            }
+        for node in set(assignments.values()):
+            sub = {i: bundles[i] for i, n in assignments.items()
+                   if n == node}
+            self._publish("pg_reserve",
+                          {"pg_id": pg_id, "bundles": sub},
+                          target_node=node)
+        return True
+
+    def _place_bundles(self, bundles, strategy):
+        """Greedy placement against the latest heartbeat availability;
+        falls back to capacity totals so a currently-busy cluster still
+        places (fragments then pend locally until resources free)."""
+        with self._lock:
+            nodes = {nid: dict(info["resources_available"])
+                     for nid, info in self._nodes.items() if info["alive"]}
+            totals = {nid: dict(info["resources_total"])
+                      for nid, info in self._nodes.items() if info["alive"]}
+        if not nodes:
+            return None
+
+        def fits(avail, b):
+            return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in b.items())
+
+        def take(avail, b):
+            for k, v in b.items():
+                avail[k] = avail.get(k, 0.0) - v
+
+        assignments: Dict[int, str] = {}
+        if strategy in ("STRICT_PACK", "PACK"):
+            # one node for everything when possible
+            for pool in (nodes, totals):
+                for nid in pool:
+                    trial = dict(pool[nid])
+                    ok = True
+                    for b in bundles:
+                        if not fits(trial, b):
+                            ok = False
+                            break
+                        take(trial, b)
+                    if ok:
+                        return {i: nid for i in range(len(bundles))}
+            if strategy == "STRICT_PACK":
+                return None
+        if strategy == "STRICT_SPREAD":
+            used: set = set()
+            for i, b in enumerate(bundles):
+                cand = next(
+                    (nid for nid in totals
+                     if nid not in used and fits(totals[nid], b)), None)
+                if cand is None:
+                    return None
+                assignments[i] = cand
+                used.add(cand)
+            return assignments
+        # PACK overflow / SPREAD: greedy, SPREAD rotates nodes.  The
+        # capacity fallback tracks CUMULATIVE placements per node — a node
+        # must fit everything assigned to it even if bundles will pend
+        # until running work frees resources.
+        order = sorted(totals)
+        trem = {nid: dict(t) for nid, t in totals.items()}
+        rr = 0
+        # first-fit-decreasing: big bundles place first so small ones
+        # don't squat on the only node the big one fits
+        by_size = sorted(range(len(bundles)),
+                         key=lambda i: -sum(bundles[i].values()))
+        for i in by_size:
+            b = bundles[i]
+            placed = None
+            for attempt in range(len(order)):
+                nid = order[(rr + attempt) % len(order)]
+                if fits(nodes[nid], b) and fits(trem[nid], b):
+                    placed = nid
+                    break
+            if placed is None:
+                placed = next(
+                    (nid for nid in order if fits(trem[nid], b)), None)
+                if placed is None:
+                    return None
+            take(nodes[placed], b)
+            take(trem[placed], b)
+            assignments[i] = placed
+            if strategy == "SPREAD":
+                rr = (order.index(placed) + 1) % len(order)
+        return assignments
+
+    def pg_fragment_ready(self, pg_id: str, node_id: str):
+        with self._lock:
+            entry = self._cluster_pgs.get(pg_id)
+            if entry is None:
+                return
+            entry["pending"].discard(node_id)
+            done = not entry["pending"]
+            if done:
+                entry["state"] = "created"
+            origin = entry["origin"]
+        if done:
+            self._publish("pg_ready", {"pg_id": pg_id}, target_node=origin)
+
+    def remove_cluster_pg(self, pg_id: str):
+        with self._lock:
+            entry = self._cluster_pgs.pop(pg_id, None)
+        if entry is None:
+            return False
+        for node in set(entry["assignments"].values()):
+            self._publish("pg_remove", {"pg_id": pg_id}, target_node=node)
+        if entry["origin"] not in set(entry["assignments"].values()):
+            self._publish("pg_remove", {"pg_id": pg_id},
+                          target_node=entry["origin"])
+        return True
+
+    def pg_info(self, pg_id: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._cluster_pgs.get(pg_id)
+            if entry is None:
+                return None
+            return {"assignments": dict(entry["assignments"]),
+                    "bundles": list(entry["bundles"]),
+                    "state": entry["state"], "origin": entry["origin"]}
 
     # ----------------------------------------------------------- kv
 
@@ -354,6 +531,7 @@ _OPS = {
     "register_actor", "update_actor", "remove_actor", "get_actor",
     "lookup_named_actor", "list_actors",
     "add_object_location", "remove_object_location", "get_object_locations",
+    "create_pg", "pg_fragment_ready", "remove_cluster_pg", "pg_info",
     "state_snapshot",
 }
 
@@ -401,7 +579,8 @@ class GcsServer:
                     rid, op = msg["rid"], msg["op"]
                     try:
                         if op == "subscribe":
-                            node_id = msg.get("node_id")
+                            node_id = msg.get("kw", {}).get(
+                                "node_id", msg.get("node_id"))
 
                             def push_cb(event, data, _sl=send_lock, _s=sock):
                                 try:

@@ -74,6 +74,9 @@ config.define("max_lineage_entries", int, 20000,
 # Inline payload for a placement group's ready() object.
 _PG_READY_BLOB = serialization.dumps(True)
 
+# sentinel: a GCS call failed transiently (vs an authoritative None)
+_GCS_ERR = object()
+
 
 class SimpleFuture:
     __slots__ = ("_event", "_value", "_error")
@@ -185,18 +188,40 @@ class _ActorState:
 
 
 class _PlacementGroup:
-    def __init__(self, pg_id, bundles: List[Dict[str, float]], strategy: str,
-                 ready_oid: Optional[ObjectID] = None):
+    """Local PG (or, in cluster mode, this node's FRAGMENT of one):
+    bundles keyed by their GLOBAL bundle index — a fragment holds only the
+    indices the GCS assigned to this node."""
+
+    def __init__(self, pg_id, bundles, strategy: str,
+                 ready_oid: Optional[ObjectID] = None,
+                 fragment: bool = False):
+        if isinstance(bundles, list):
+            bundles = {i: b for i, b in enumerate(bundles)}
         self.pg_id = pg_id
-        self.bundles = bundles
-        self.available = [dict(b) for b in bundles]
+        self.bundles: Dict[int, Dict[str, float]] = bundles
+        self.available = {i: dict(b) for i, b in bundles.items()}
         self.strategy = strategy
         self.state = "pending"  # pending | created
         self.ready_oid = ready_oid
+        self.fragment = fragment  # cluster PG piece; GCS owns the whole
+        # bundle indices whose node resources are NOT yet acquired.
+        # Whole PGs reserve atomically (all-or-nothing, no inter-PG
+        # deadlock); fragments reserve per bundle (node-death repair can
+        # extend a live fragment).
+        self.unreserved = set(bundles.keys())
+
+    def reserved_total(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for i, b in self.bundles.items():
+            if i in self.unreserved:
+                continue
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
 
     def total(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
-        for b in self.bundles:
+        for b in self.bundles.values():
             for k, v in b.items():
                 total[k] = total.get(k, 0.0) + v
         return total
@@ -324,6 +349,8 @@ class Raylet:
         # lineage bookkeeping (bounded; see submit_task)
         self._lineage_count = 0
         self._reconstructing: set = set()
+        # cluster PGs this node originated: pg_id -> ready ObjectID
+        self._cluster_pg_ready: Dict[str, Optional[ObjectID]] = {}
 
         # ---- cluster state (all event-thread owned) ----
         self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
@@ -807,6 +834,35 @@ class Raylet:
                 self._object_ready(oid)
             if oid in self._object_waiters or oid in self._dep_index:
                 self._maybe_pull(oid)
+        elif event == "pg_reserve":
+            # GCS assigned this node a fragment of a cluster PG: register
+            # it pending; _activate_pending_pgs (first thing every
+            # schedule pass) reserves it and posts pg_fragment_ready.
+            existing = self._pgs.get(data["pg_id"])
+            if existing is not None and existing.fragment:
+                # node-death repair can extend our fragment
+                for i, b in data["bundles"].items():
+                    if i not in existing.bundles:
+                        existing.bundles[i] = b
+                        existing.available[i] = dict(b)
+                        existing.unreserved.add(i)
+                        existing.state = "pending"  # reserve the new piece
+            else:
+                self._pgs[data["pg_id"]] = _PlacementGroup(
+                    data["pg_id"], data["bundles"], "FRAGMENT",
+                    fragment=True)
+            self._schedule()
+        elif event == "pg_ready":
+            oid = self._cluster_pg_ready.pop(data["pg_id"], None)
+            if oid is not None:
+                self._object_inline(oid, _PG_READY_BLOB)
+        elif event == "pg_remove":
+            oid = self._cluster_pg_ready.pop(data["pg_id"], None)
+            if oid is not None and self._object_status(oid) == "pending":
+                self._object_error(oid, ValueError(
+                    f"placement group {data['pg_id']} was removed before "
+                    "its bundles could be reserved"))
+            self.remove_pg(data["pg_id"], _from_gcs=True)
 
     def _on_node_death(self, node_id: str, reason: str):
         self._cluster_nodes.pop(node_id, None)
@@ -1071,6 +1127,15 @@ class Raylet:
             return fn(*args, **kw)
         except (ConnectionError, TimeoutError, OSError):
             return None
+
+    def _gcs_err_ok(self, fn, *args, **kw):
+        """Like _gcs_safe but distinguishes an RPC failure (_GCS_ERR) from
+        an authoritative None — callers must not treat a timeout as
+        'does not exist'."""
+        try:
+            return fn(*args, **kw)
+        except (ConnectionError, TimeoutError, OSError):
+            return _GCS_ERR
 
     def _gcs_post(self, op: str, *args, **kw):
         """One-way GCS update (no reply wait) — keeps the event thread off
@@ -1776,11 +1841,14 @@ class Raylet:
                 return None, None
             idx = placement.get("bundle", 0)
             if idx == -1:
-                for b in pg.available:
+                for b in pg.available.values():
                     if _fits(b, spec.resources):
                         return b, spec.resources
                 return None, spec.resources
-            return pg.available[idx], spec.resources
+            pool = pg.available.get(idx)
+            if pool is None:
+                return None, None  # bundle lives on another node's fragment
+            return pool, spec.resources
         return self.resources_available, spec.resources
 
     def _release_task_resources(self, spec: TaskSpec):
@@ -1807,12 +1875,25 @@ class Raylet:
         for pg in self._pgs.values():
             if pg.state != "pending":
                 continue
-            total = pg.total()
-            if _fits(self.resources_available, total):
+            if pg.fragment:
+                for i in sorted(pg.unreserved):
+                    if _fits(self.resources_available, pg.bundles[i]):
+                        _acquire(self.resources_available, pg.bundles[i])
+                        pg.unreserved.discard(i)
+                if pg.unreserved:
+                    continue
+            else:
+                total = pg.total()
+                if not _fits(self.resources_available, total):
+                    continue
                 _acquire(self.resources_available, total)
-                pg.state = "created"
-                if pg.ready_oid is not None:
-                    self._object_inline(pg.ready_oid, _PG_READY_BLOB)
+                pg.unreserved.clear()
+            pg.state = "created"
+            if pg.fragment:
+                self._gcs_post("pg_fragment_ready", pg.pg_id,
+                               self.node_id)
+            if pg.ready_oid is not None:
+                self._object_inline(pg.ready_oid, _PG_READY_BLOB)
 
     def _schedule(self):
         """Request a scheduling pass (coalesced; see _run)."""
@@ -1858,10 +1939,76 @@ class Raylet:
             if pool is None:
                 # Distinguish "not schedulable yet" (pending PG, full
                 # bundles → defer) from "never schedulable" (PG removed or
-                # unknown → fail now, else the task defers forever).
+                # unknown → fail now, else the task defers forever) from
+                # "bundle on ANOTHER node's fragment" (cluster → forward).
                 # _object_error re-enters _schedule, so only collect here.
                 pg_hex = (spec.placement or {}).get("pg")
-                if pg_hex and pg_hex not in self._pgs:
+                idx = (spec.placement or {}).get("bundle", 0)
+                local = self._pgs.get(pg_hex) if pg_hex else None
+                if (local is not None and not local.fragment and idx != -1
+                        and idx not in local.bundles):
+                    # out-of-range bundle index on a whole local PG: fail
+                    # loudly instead of deferring forever
+                    err = ValueError(
+                        f"bundle index {idx} out of range for placement "
+                        f"group {pg_hex} ({len(local.bundles)} bundles)")
+                    for rid in spec.return_ids():
+                        self._object_error(rid, err)
+                    self._record_event(spec, "FAILED", bad_bundle=True)
+                    continue
+                if pg_hex and self.cluster_mode and spill_queries < 8:
+                    bundle_elsewhere = (
+                        local is None
+                        or (local.fragment
+                            and (idx != -1 and idx not in local.available
+                                 or idx == -1 and not any(
+                                     _fits(b, spec.resources)
+                                     for b in local.bundles.values()))))
+                    if bundle_elsewhere:
+                        spill_queries += 1
+                        info = self._gcs_err_ok(self.gcs.pg_info, pg_hex)
+                        if info is _GCS_ERR:
+                            deferred.append(spec)  # transient GCS trouble
+                            no_progress += 1
+                            continue
+                        if info is not None:
+                            if info["state"] != "created":
+                                deferred.append(spec)
+                                no_progress += 1
+                                continue
+                            if idx != -1:
+                                target = info["assignments"].get(idx)
+                                if (target is None
+                                        and idx >= len(info["bundles"])):
+                                    err = ValueError(
+                                        f"bundle index {idx} out of range "
+                                        f"for placement group {pg_hex}")
+                                    for rid in spec.return_ids():
+                                        self._object_error(rid, err)
+                                    self._record_event(spec, "FAILED",
+                                                       bad_bundle=True)
+                                    continue
+                            else:
+                                # any-bundle: pick a node whose ASSIGNED
+                                # bundle can fit this task
+                                target = next(
+                                    (n for i2, n in sorted(
+                                        info["assignments"].items())
+                                     if _fits(dict(info["bundles"][i2]),
+                                              spec.resources)), None)
+                            if (target and target != self.node_id
+                                    and self._forward_task(spec, target)):
+                                continue
+                            deferred.append(spec)
+                            no_progress += 1
+                            continue
+                        # authoritative: the GCS has no such PG
+                        if local is None:
+                            pg_orphans.append(spec)
+                            continue
+                if pg_hex and pg_hex not in self._pgs \
+                        and not self.cluster_mode:
+                    # cluster mode orphans only via the GCS lookup above
                     pg_orphans.append(spec)
                     continue
                 deferred.append(spec)
@@ -2439,6 +2586,18 @@ class Raylet:
 
     def create_pg(self, pg_id: str, bundles: List[Dict[str, float]],
                   strategy: str, ready_oid: Optional[ObjectID] = None) -> bool:
+        if self.cluster_mode:
+            # GCS places bundles across nodes and pushes pg_reserve to the
+            # involved raylets; ready resolves on the pg_ready push.
+            # Transient GCS failures RAISE (propagating to the caller)
+            # rather than masquerading as "exceeds capacity".
+            ok = self.gcs.create_pg(pg_id, bundles, strategy, self.node_id)
+            if not ok:
+                return False
+            if ready_oid is not None:
+                self._obj(ready_oid)
+            self._cluster_pg_ready[pg_id] = ready_oid
+            return True
         pg = _PlacementGroup(pg_id, bundles, strategy, ready_oid=ready_oid)
         total = pg.total()
         if not _fits(self.resources_total, total):
@@ -2450,6 +2609,7 @@ class Raylet:
         self._pgs[pg_id] = pg
         if _fits(self.resources_available, total):
             _acquire(self.resources_available, total)
+            pg.unreserved.clear()
             pg.state = "created"
             if ready_oid is not None:
                 self._object_inline(ready_oid, _PG_READY_BLOB)
@@ -2460,9 +2620,21 @@ class Raylet:
 
     def pg_state(self, pg_id: str) -> Optional[str]:
         pg = self._pgs.get(pg_id)
+        if pg is not None and not pg.fragment:
+            return pg.state
+        if self.cluster_mode:
+            info = self._gcs_safe(self.gcs.pg_info, pg_id)
+            if info is not None:
+                return info["state"] if info["state"] == "created" \
+                    else "pending"
         return pg.state if pg is not None else None
 
-    def remove_pg(self, pg_id: str):
+    def remove_pg(self, pg_id: str, _from_gcs: bool = False):
+        if self.cluster_mode and not _from_gcs:
+            # cluster PG: the GCS fans pg_remove out to every fragment
+            # holder (including us); local cleanup happens on that push
+            if self._gcs_safe(self.gcs.remove_cluster_pg, pg_id):
+                return
         pg = self._pgs.pop(pg_id, None)
         if pg is None:
             return
@@ -2523,13 +2695,16 @@ class Raylet:
                             except OSError:
                                 pass
                         break
-            _release(self.resources_available, pg.total())
-        elif pg.ready_oid is not None:
-            # A still-pending PG will never become ready: fail its ready()
-            # object so waiters unblock instead of hanging forever.
-            self._object_error(pg.ready_oid, ValueError(
-                f"placement group {pg_id} was removed before its bundles "
-                "could be reserved"))
+            _release(self.resources_available, pg.reserved_total())
+        else:
+            # pending: a FRAGMENT may hold per-bundle partial reservations
+            _release(self.resources_available, pg.reserved_total())
+            if pg.ready_oid is not None:
+                # never becomes ready: fail its ready() object so waiters
+                # unblock instead of hanging forever
+                self._object_error(pg.ready_oid, ValueError(
+                    f"placement group {pg_id} was removed before its "
+                    "bundles could be reserved"))
         self._schedule()
 
     # --------------------------------------------------------------- state
@@ -2566,7 +2741,9 @@ class Raylet:
                 "num": len(self._objects),
             },
             "placement_groups": [
-                {"id": pg.pg_id, "state": pg.state, "bundles": pg.bundles}
+                {"id": pg.pg_id, "state": pg.state,
+                 "bundles": list(pg.bundles.values()),
+                 "fragment": pg.fragment}
                 for pg in self._pgs.values()
             ],
             "events": list(self._task_events),

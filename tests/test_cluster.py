@@ -152,6 +152,62 @@ def test_cross_node_streaming(cluster):
     assert int(ray_tpu.get(refs[3], timeout=60)[0]) == 7
 
 
+def test_cluster_placement_group_spreads_bundles(cluster):
+    """A PG too big for any single node spreads bundles across nodes
+    (reference: GcsPlacementGroupScheduler bundle policies); tasks pinned
+    to a bundle run on the node holding that bundle's fragment."""
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    # head has 1 CPU, worker node has 2: [1 CPU, 2 CPU] cannot STRICT_PACK
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 2}],
+                                 strategy="SPREAD")
+    assert pg.wait(30), "cluster PG never became ready"
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ.get("RAY_TPU_SESSION_DIR")
+
+    s0 = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote(),
+        timeout=60)
+    s1 = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=1)).remote(),
+        timeout=60)
+    assert s0 != s1, "bundles must land on different nodes"
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_cluster_pg_infeasible_rejected(cluster):
+    with pytest.raises(ValueError):
+        ray_tpu.placement_group([{"CPU": 64}], strategy="STRICT_PACK")
+
+
+def test_cluster_pg_remove_fails_queued(cluster):
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    pg = ray_tpu.placement_group([{"CPU": 1}])
+    assert pg.wait(30)
+    ray_tpu.remove_placement_group(pg)
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ref = f.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg)).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=20)
+
+
 class TestNodeFailure:
     """Node death: detection, task retry, actor failover (fresh cluster per
     test — killing nodes poisons the shared fixture)."""
