@@ -79,10 +79,16 @@ def init(
     if local_mode:
         init_worker(LocalWorker())
         return
+    if address is None:
+        # Auto-attach for entrypoints launched by the job manager
+        # (reference: RAY_ADDRESS handling in ray.init).
+        import os as _os
+
+        address = _os.environ.get("RAY_TPU_ADDRESS") or None
     if address is not None:
         from ray_tpu.core.client import ClientWorker
 
-        init_worker(ClientWorker(address))
+        init_worker(ClientWorker(address, log_to_driver=log_to_driver))
         return
     init_worker(
         DriverWorker(

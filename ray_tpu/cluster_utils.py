@@ -48,6 +48,58 @@ def _read_tagged_line(proc: subprocess.Popen, tag: str, timeout: float = 30.0):
     raise TimeoutError(f"timed out waiting for {tag!r} banner")
 
 
+def make_cluster_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for spawned GCS/raylet processes: driver import path,
+    fast failure detection for tests, CPU-only jax."""
+    env = dict(os.environ)
+    # Subprocesses must resolve ray_tpu (and the user's modules) no
+    # matter their cwd — propagate the driver's import path, the same
+    # way the raylet ships it to workers.
+    path_entries = [p for p in sys.path if p] + [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+    ]
+    seen: set = set()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in path_entries if not (p in seen or seen.add(p)))
+    # Fast failure detection for tests (prod tunes these up).
+    env.setdefault("RAY_TPU_GCS_HEARTBEAT_INTERVAL_S", "0.1")
+    env.setdefault("RAY_TPU_GCS_NODE_TIMEOUT_S", "1.5")
+    # Cluster workers are control-plane only in tests: never let them
+    # grab the TPU chip or spend seconds importing jax eagerly.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra or {})
+    return env
+
+
+def spawn_gcs(env: Dict[str, str]):
+    """Start a GCS server process; returns ``(proc, address)``."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.gcs_main"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    banner = _read_tagged_line(proc, "GCS_ADDRESS")
+    return proc, banner.split()[1]
+
+
+def spawn_raylet(gcs_address: str, resources: Dict[str, float],
+                 object_store_mb: int, env: Dict[str, str]) -> NodeHandle:
+    """Start one raylet process against ``gcs_address`` and wait for its
+    startup banner."""
+    import json
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.raylet_main",
+         "--gcs", gcs_address,
+         "--resources", json.dumps(resources),
+         "--store-mb", str(object_store_mb)],
+        stdout=subprocess.PIPE, stderr=None,
+        text=True, env=env)
+    banner = _read_tagged_line(proc, "RAYLET")
+    fields = dict(kv.split("=") for kv in banner.split()[1:])
+    return NodeHandle(proc, fields["node_id"], int(fields["port"]),
+                      dict(resources))
+
+
 class Cluster:
     """Start with a head node, then ``add_node`` more; ``connect`` attaches
     the current process as a driver (``ray_tpu.init(address=...)``)."""
@@ -55,30 +107,9 @@ class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_resources: Optional[Dict[str, float]] = None,
                  env: Optional[Dict[str, str]] = None):
-        self._env = dict(os.environ)
-        # Subprocesses must resolve ray_tpu (and the user's modules) no
-        # matter their cwd — propagate the driver's import path, the same
-        # way the raylet ships it to workers.
-        path_entries = [p for p in sys.path if p] + [
-            p for p in self._env.get("PYTHONPATH", "").split(os.pathsep) if p
-        ]
-        seen: set = set()
-        self._env["PYTHONPATH"] = os.pathsep.join(
-            p for p in path_entries if not (p in seen or seen.add(p)))
-        # Fast failure detection for tests (prod tunes these up).
-        self._env.setdefault("RAY_TPU_GCS_HEARTBEAT_INTERVAL_S", "0.1")
-        self._env.setdefault("RAY_TPU_GCS_NODE_TIMEOUT_S", "1.5")
-        # Cluster workers are control-plane only in tests: never let them
-        # grab the TPU chip or spend seconds importing jax eagerly.
-        self._env.setdefault("JAX_PLATFORMS", "cpu")
-        self._env.update(env or {})
+        self._env = make_cluster_env(env)
         self.nodes: List[NodeHandle] = []
-        self._gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.gcs_main"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=self._env)
-        banner = _read_tagged_line(self._gcs_proc, "GCS_ADDRESS")
-        self.address = banner.split()[1]
+        self._gcs_proc, self.address = spawn_gcs(self._env)
         self._connected = False
         if initialize_head:
             self.head_node = self.add_node(
@@ -91,18 +122,7 @@ class Cluster:
         if num_tpus:
             res["TPU"] = float(num_tpus)
         res.update(resources or {})
-        import json
-
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.raylet_main",
-             "--gcs", self.address,
-             "--resources", json.dumps(res),
-             "--store-mb", str(object_store_mb)],
-            stdout=subprocess.PIPE, stderr=None,
-            text=True, env=self._env)
-        banner = _read_tagged_line(proc, "RAYLET")
-        fields = dict(kv.split("=") for kv in banner.split()[1:])
-        handle = NodeHandle(proc, fields["node_id"], int(fields["port"]), res)
+        handle = spawn_raylet(self.address, res, object_store_mb, self._env)
         self.nodes.append(handle)
         return handle
 

@@ -12,6 +12,7 @@ large objects would need a socket fetch (not yet wired — same-host only).
 from __future__ import annotations
 
 import socket
+import sys
 import threading
 from typing import Dict, Optional
 
@@ -24,8 +25,10 @@ from ray_tpu.core.worker import Worker
 class ClientWorker(Worker):
     """Driver-side connection to a raylet over TCP ("client" mode)."""
 
-    def __init__(self, gcs_address: str, node_id: Optional[str] = None):
+    def __init__(self, gcs_address: str, node_id: Optional[str] = None,
+                 log_to_driver: bool = True):
         super().__init__("client")
+        self.log_to_driver = log_to_driver
         self.gcs = GcsClient(gcs_address)
         nodes = [n for n in self.gcs.nodes() if n["alive"] and n["address"]]
         if not nodes:
@@ -86,6 +89,19 @@ class ClientWorker(Worker):
                 if entry is not None:
                     entry["msg"] = msg
                     entry["event"].set()
+            elif t == "log":
+                # Worker stdout/stderr tailed by the raylet (reference: the
+                # LogMonitor → driver console path, `log_monitor.py:102`).
+                if self.log_to_driver:
+                    prefix = (f"({msg.get('pid')}, "
+                              f"node={str(msg.get('node_id'))[:8]}) ")
+                    out = "".join(prefix + ln + "\n"
+                                  for ln in msg.get("lines", ()))
+                    try:
+                        sys.stdout.write(out)
+                        sys.stdout.flush()
+                    except (OSError, ValueError):
+                        pass
 
     def _send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)

@@ -117,15 +117,50 @@ class GcsCore:
         self._mark_dead(node_id, "node drained")
 
     def heartbeat(self, node_id: str, resources_available: Dict[str, float],
-                  queue_len: int = 0) -> bool:
+                  queue_len: int = 0, pending_shapes=None) -> bool:
+        """``pending_shapes`` is the node's unfulfilled resource demand:
+        ``[(shape_dict, count), ...]`` for queued tasks that cannot run with
+        current availability — the load signal the autoscaler bin-packs
+        (reference: raylet resource reports aggregated by
+        ``monitor.py:249`` ``update_load_metrics``)."""
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
                 return False
             info["resources_available"] = dict(resources_available)
             info["queue_len"] = queue_len
-            info["last_heartbeat"] = time.monotonic()
+            info["pending_shapes"] = list(pending_shapes or ())
+            now = time.monotonic()
+            info["last_heartbeat"] = now
+            busy = (queue_len > 0 or pending_shapes
+                    or any(resources_available.get(k, 0.0) + 1e-9 < v
+                           for k, v in info["resources_total"].items()))
+            if busy:
+                info.pop("idle_since", None)
+            elif "idle_since" not in info:
+                info["idle_since"] = now
             return True
+
+    def load_metrics(self) -> List[dict]:
+        """Autoscaler view: per-node capacity, availability, queue depth,
+        unfulfilled demand shapes, and idle duration."""
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for info in self._nodes.values():
+                out.append({
+                    "node_id": info["node_id"],
+                    "alive": info["alive"],
+                    "resources_total": dict(info["resources_total"]),
+                    "resources_available": dict(
+                        info.get("resources_available", {})),
+                    "queue_len": info.get("queue_len", 0),
+                    "pending_shapes": list(info.get("pending_shapes", ())),
+                    "idle_s": (now - info["idle_since"]
+                               if info.get("idle_since") is not None
+                               and info["alive"] else 0.0),
+                })
+            return out
 
     def nodes(self) -> List[dict]:
         with self._lock:
@@ -511,9 +546,18 @@ class GcsCore:
 
     def state_snapshot(self) -> dict:
         with self._lock:
+            pgs = [
+                {"pg_id": pid, "state": info["state"],
+                 "strategy": info["strategy"],
+                 "bundles": info["bundles"],
+                 "assignments": {str(k): v
+                                 for k, v in info["assignments"].items()}}
+                for pid, info in self._cluster_pgs.items()
+            ]
             return {
                 "nodes": [dict(n) for n in self._nodes.values()],
                 "actors": self.list_actors(),
+                "placement_groups": pgs,
                 "num_objects_tracked": len(self._objects),
                 "num_kv": len(self._kv),
             }
@@ -525,7 +569,7 @@ class GcsCore:
 
 _OPS = {
     "register_node", "unregister_node", "heartbeat", "nodes", "get_node",
-    "place_task", "feasible_nodes",
+    "place_task", "feasible_nodes", "load_metrics",
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "put_function", "get_function",
     "register_actor", "update_actor", "remove_actor", "get_actor",

@@ -351,6 +351,13 @@ class Raylet:
         self._reconstructing: set = set()
         # cluster PGs this node originated: pg_id -> ready ObjectID
         self._cluster_pg_ready: Dict[str, Optional[ObjectID]] = {}
+        # Worker log tailing (reference: LogMonitor,
+        # `python/ray/_private/log_monitor.py:102`): in cluster mode worker
+        # stdio goes to per-worker files; a timer tails them and pushes new
+        # lines to attached drivers.
+        self._worker_log_seq = itertools.count()
+        self._worker_log_tails: Dict[str, dict] = {}  # path -> {pos, pid}
+        self._log_timer_armed = False
 
         # ---- cluster state (all event-thread owned) ----
         self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
@@ -588,12 +595,65 @@ class Raylet:
         ]
         if self.store_path:
             cmd += ["--store", self.store_path]
-        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
+        stdout = stderr = None
+        if self.cluster_mode and self.session_dir:
+            # Per-worker combined log file, tailed to drivers (reference:
+            # worker log files under the session dir + LogMonitor tailing,
+            # `log_monitor.py:102`). Also keeps worker prints out of the
+            # raylet's (undrained) stdout pipe.
+            log_dir = os.path.join(self.session_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(
+                log_dir, f"worker-{next(self._worker_log_seq):05d}.log")
+            logf = open(log_path, "ab", buffering=0)
+            stdout = stderr = logf
+            self._worker_log_tails[log_path] = {"pos": 0, "pid": None}
+            if not self._log_timer_armed:
+                self._log_timer_armed = True
+                self.add_timer(0.3, self._pump_worker_logs)
+        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
+                                stdout=stdout, stderr=stderr)
+        if stdout is not None:
+            stdout.close()  # child keeps its copy
+            self._worker_log_tails[log_path]["pid"] = proc.pid
         self._procs.append(proc)
         self._unregistered.append((proc, profile))
         if not self._health_timer_armed:
             self._health_timer_armed = True
             self.add_timer(config.health_check_period_s, self._health_check)
+
+    def _pump_worker_logs(self):
+        """Tail worker log files; push new complete lines to attached
+        drivers (reference: LogMonitor → GCS pubsub → driver console)."""
+        drivers = [c for c in self._workers.values()
+                   if getattr(c, "state", None) == "driver"]
+        for path, tail in list(self._worker_log_tails.items()):
+            try:
+                with open(path, "rb") as f:
+                    f.seek(tail["pos"])
+                    data = f.read()
+            except OSError:
+                self._worker_log_tails.pop(path, None)
+                continue
+            if not data:
+                continue
+            # Only ship complete lines; keep the partial tail for next tick.
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                continue
+            tail["pos"] += cut + 1
+            lines = data[:cut].decode("utf-8", "replace").splitlines()
+            if not drivers or not lines:
+                continue
+            msg = {"t": "log", "node_id": self.node_id,
+                   "pid": tail["pid"], "lines": lines}
+            for conn in drivers:
+                try:
+                    conn.send(msg)
+                except OSError:
+                    pass
+        if not self._shutdown:
+            self.add_timer(0.3, self._pump_worker_logs)
 
     def _health_check(self):
         """Reap workers that died before registering (e.g. import failure) so
@@ -781,10 +841,23 @@ class Raylet:
 
     # --------------------------------------------------------------- cluster
 
+    def _pending_demand_shapes(self, cap: int = 256):
+        """Aggregate resource shapes of queued tasks that cannot run with
+        current availability — the autoscaler's scale-up signal."""
+        shapes: Dict[tuple, int] = {}
+        for spec in itertools.islice(self._ready_queue, cap):
+            need = spec.resources or {}
+            if _fits(self.resources_available, need):
+                continue
+            key = tuple(sorted(need.items()))
+            shapes[key] = shapes.get(key, 0) + 1
+        return [(dict(k), n) for k, n in shapes.items()]
+
     def _heartbeat(self):
         try:
             ok = self.gcs.heartbeat(self.node_id, self.resources_available,
-                                    queue_len=len(self._ready_queue))
+                                    queue_len=len(self._ready_queue),
+                                    pending_shapes=self._pending_demand_shapes())
             if not ok:
                 # GCS lost track of us (restart / marked dead): re-register.
                 self.gcs.register_node(

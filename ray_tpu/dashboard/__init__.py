@@ -1,0 +1,205 @@
+"""Dashboard head: HTTP API over cluster state + Prometheus metrics.
+
+Reference analogue: `dashboard/head.py:81` (aiohttp app with per-subsystem
+modules) + `dashboard/state_aggregator.py`.  Re-designed small: one
+threaded HTTP server reading the GCS tables directly over the existing
+framed-socket client — no agent processes, no driver attach — so it can
+run next to the GCS on the head node or anywhere that can reach it.
+
+Endpoints:
+  GET /                      tiny HTML overview
+  GET /api/nodes             GCS node table
+  GET /api/actors            GCS actor table
+  GET /api/jobs              job-submission records (GCS KV)
+  GET /api/cluster_resources {total, available} aggregated over alive nodes
+  GET /api/load              autoscaler load metrics (demand + idle)
+  GET /api/placement_groups  cluster PG table
+  GET /metrics               Prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+from ray_tpu.core.gcs import GcsClient
+
+__all__ = ["DashboardHead"]
+
+
+def _prom_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._gcs = GcsClient(gcs_address)
+        self._gcs_address = gcs_address
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    path = urlparse(self.path).path
+                    body, ctype = dash._route(path)
+                except KeyError:
+                    self.send_error(404)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self.send_error(500, str(e))
+                    return
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------- routing
+
+    def _route(self, path: str):
+        if path == "/":
+            return self._index(), "text/html"
+        if path == "/metrics":
+            return self._metrics(), "text/plain; version=0.0.4"
+        api = {
+            "/api/nodes": self._nodes,
+            "/api/actors": self._actors,
+            "/api/jobs": self._jobs,
+            "/api/cluster_resources": self._cluster_resources,
+            "/api/load": self._load,
+            "/api/placement_groups": self._pgs,
+        }
+        if path in api:
+            return json.dumps(api[path](), default=str), "application/json"
+        raise KeyError(path)
+
+    # ------------------------------------------------------------- sources
+
+    def _nodes(self):
+        return self._gcs.nodes()
+
+    def _actors(self):
+        return self._gcs.list_actors()
+
+    def _jobs(self):
+        out = []
+        for key in self._gcs.kv_keys("jobs", b""):
+            if key.endswith(b"/logs"):
+                continue
+            raw = self._gcs.kv_get("jobs", key)
+            if raw:
+                out.append(json.loads(raw))
+        return out
+
+    def _cluster_resources(self):
+        total: dict = {}
+        avail: dict = {}
+        for n in self._gcs.nodes():
+            if not n["alive"]:
+                continue
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.get("resources_available", {}).items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+    def _load(self):
+        return self._gcs.load_metrics()
+
+    def _pgs(self):
+        return self._gcs.state_snapshot().get("placement_groups", [])
+
+    # ------------------------------------------------------------- metrics
+
+    def _metrics(self) -> str:
+        """Prometheus text exposition (reference: the per-node MetricsAgent
+        re-export, `python/ray/_private/metrics_agent.py:375`).  System
+        gauges from GCS state + any user metrics pushed to the GCS KV by
+        ``ray_tpu.util.metrics``."""
+        lines = []
+        nodes = self._gcs.nodes()
+        alive = [n for n in nodes if n["alive"]]
+        lines.append("# TYPE ray_tpu_nodes_alive gauge")
+        lines.append(f"ray_tpu_nodes_alive {len(alive)}")
+        lines.append("# TYPE ray_tpu_resource_total gauge")
+        lines.append("# TYPE ray_tpu_resource_available gauge")
+        for n in alive:
+            nid = n["node_id"][:12]
+            for k, v in n["resources_total"].items():
+                lines.append(
+                    f'ray_tpu_resource_total{{node="{nid}",'
+                    f'resource="{_prom_escape(k)}"}} {v}')
+            for k, v in n.get("resources_available", {}).items():
+                lines.append(
+                    f'ray_tpu_resource_available{{node="{nid}",'
+                    f'resource="{_prom_escape(k)}"}} {v}')
+        lines.append("# TYPE ray_tpu_actors gauge")
+        states: dict = {}
+        for a in self._gcs.list_actors():
+            states[a.get("state", "?")] = states.get(a.get("state", "?"), 0) + 1
+        for st, count in sorted(states.items()):
+            lines.append(f'ray_tpu_actors{{state="{_prom_escape(st)}"}} '
+                         f'{count}')
+        # User metrics: serialized samples under KV ns "metrics".
+        try:
+            from ray_tpu.util.metrics import render_kv_metrics
+
+            lines.extend(render_kv_metrics(self._gcs))
+        except ImportError:
+            pass
+        return "\n".join(lines) + "\n"
+
+    # -------------------------------------------------------------- index
+
+    def _index(self) -> str:
+        res = self._cluster_resources()
+        nodes = self._nodes()
+        jobs = self._jobs()
+        rows = "".join(
+            f"<tr><td>{n['node_id'][:12]}</td>"
+            f"<td>{'ALIVE' if n['alive'] else 'DEAD'}</td>"
+            f"<td>{json.dumps(n['resources_total'])}</td></tr>"
+            for n in nodes)
+        job_rows = "".join(
+            f"<tr><td>{j['submission_id']}</td><td>{j['status']}</td>"
+            f"<td><code>{j['entrypoint'][:80]}</code></td></tr>"
+            for j in jobs)
+        return f"""<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
+collapse}}td,th{{border:1px solid #ccc;padding:4px 8px}}</style></head>
+<body><h1>ray_tpu</h1>
+<p>GCS: <code>{self._gcs_address}</code></p>
+<p>resources: <code>{json.dumps(res)}</code></p>
+<h2>nodes</h2><table><tr><th>id</th><th>state</th><th>resources</th></tr>
+{rows}</table>
+<h2>jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>
+{job_rows}</table>
+<p>APIs: /api/nodes /api/actors /api/jobs /api/cluster_resources /api/load
+/api/placement_groups /metrics</p>
+</body></html>"""
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            self._gcs.close()
+        except Exception:  # noqa: BLE001
+            pass

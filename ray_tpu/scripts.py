@@ -109,6 +109,36 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.job_command == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        job_id = client.submit_job(
+            entrypoint=entrypoint, submission_id=args.submission_id)
+        print(f"submitted {job_id}")
+        if not args.no_wait:
+            for chunk in client.tail_job_logs(job_id):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            status = client.get_job_status(job_id)
+            print(f"job {job_id}: {status}")
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_command == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_command == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_command == "stop":
+        ok = client.stop_job(args.job_id)
+        print("stopped" if ok else "not running")
+    elif args.job_command == "list":
+        for job in client.list_jobs():
+            print(f"{job['submission_id']}\t{job['status']}\t"
+                  f"{job['entrypoint'][:60]}")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     ray_tpu = _connect(args)
     events = ray_tpu.timeline(args.out)
@@ -138,6 +168,23 @@ def main(argv=None) -> int:
     p.add_argument("what", choices=["nodes", "actors", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("job", help="job submission (reference: ray job ...)")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--address", required=True)
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    js.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("--address", required=True)
+        jp.add_argument("job_id")
+        jp.set_defaults(fn=cmd_job)
+    jl = jsub.add_parser("list")
+    jl.add_argument("--address", required=True)
+    jl.set_defaults(fn=cmd_job)
 
     p = sub.add_parser("timeline", help="chrome://tracing dump")
     p.add_argument("--address", required=True)

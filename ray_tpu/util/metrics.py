@@ -1,0 +1,264 @@
+"""User metrics API: Counter / Gauge / Histogram.
+
+Reference analogue: `python/ray/util/metrics.py` (Counter `:150`,
+Histogram `:215`, Gauge `:290`) backed by the C++ OpenCensus registry and a
+per-node MetricsAgent re-exporting Prometheus
+(`python/ray/_private/metrics_agent.py:375`).
+
+TPU-first re-design: no per-node agent processes — each worker process
+batches its metric samples and flushes them to the GCS KV (namespace
+``metrics``, key ``<pid-uuid>/<metric>``); the dashboard's ``/metrics``
+endpoint merges every producer's samples into one Prometheus text page
+(counters sum, gauges take the latest write, histogram buckets add).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "flush_metrics",
+           "render_kv_metrics"]
+
+_NS = "metrics"
+_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_FLUSH_S", "1.0"))
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_producer_id = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+_flusher_started = False
+
+
+def _kv_put(key: bytes, value: bytes) -> bool:
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod._global_worker  # raw slot: may be None before init
+    if w is None:
+        return False
+    try:
+        w.kv_put(key, value, namespace=_NS)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            try:
+                flush_metrics()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threading.Thread(target=loop, name="metrics-flush", daemon=True).start()
+
+
+def flush_metrics():
+    """Push every registered metric's samples to the GCS KV now."""
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        payload = m._export()
+        if payload is None:
+            continue
+        _kv_put(f"{_producer_id}/{m.name}".encode(),
+                json.dumps(payload).encode())
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or any(c in name for c in " \n\t"):
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        unknown = set(tags) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in tag_keys")
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"tags {unknown} not in tag_keys "
+                             f"{self.tag_keys}")
+        return tuple(sorted(merged.items()))
+
+    def _export(self) -> Optional[dict]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic cumulative count (reference `metrics.py:150`)."""
+
+    def __init__(self, name, description: str = "", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() takes a non-negative value")
+        key = self._resolve_tags(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _export(self):
+        with self._lock:
+            if not self._values:
+                return None
+            return {"type": "counter", "desc": self.description,
+                    "samples": [[list(k), v]
+                                for k, v in self._values.items()]}
+
+
+class Gauge(Metric):
+    """Point-in-time value (reference `metrics.py:290`)."""
+
+    def __init__(self, name, description: str = "", tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, Tuple[float, float]] = {}  # key -> (v, ts)
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        with self._lock:
+            self._values[key] = (float(value), time.time())
+
+    def _export(self):
+        with self._lock:
+            if not self._values:
+                return None
+            return {"type": "gauge", "desc": self.description,
+                    "samples": [[list(k), v, ts]
+                                for k, (v, ts) in self._values.items()]}
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference `metrics.py:215`)."""
+
+    def __init__(self, name, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None, tag_keys=None):
+        super().__init__(name, description, tag_keys)
+        bounds = sorted(boundaries or (0.1, 1.0, 10.0, 100.0))
+        if any(b <= 0 for b in bounds):
+            raise ValueError("histogram boundaries must be positive")
+        self.boundaries = tuple(bounds)
+        # key -> [bucket_counts..., +inf_count, sum, count]
+        self._values: Dict[Tuple, list] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._resolve_tags(tags)
+        with self._lock:
+            rec = self._values.get(key)
+            if rec is None:
+                rec = [0] * (len(self.boundaries) + 1) + [0.0, 0]
+                self._values[key] = rec
+            idx = next((i for i, b in enumerate(self.boundaries)
+                        if value <= b), len(self.boundaries))
+            rec[idx] += 1
+            rec[-2] += float(value)
+            rec[-1] += 1
+
+    def _export(self):
+        with self._lock:
+            if not self._values:
+                return None
+            return {"type": "histogram", "desc": self.description,
+                    "bounds": list(self.boundaries),
+                    "samples": [[list(k), list(v)]
+                                for k, v in self._values.items()]}
+
+
+# --------------------------------------------------------------- rendering
+
+
+def render_kv_metrics(gcs) -> List[str]:
+    """Merge every producer's KV samples into Prometheus text lines — used
+    by the dashboard's /metrics endpoint.  ``gcs`` is a GcsClient (or any
+    object with kv_keys/kv_get taking (namespace, key))."""
+    merged: Dict[str, dict] = {}
+    for key in gcs.kv_keys(_NS, b""):
+        raw = gcs.kv_get(_NS, key)
+        if not raw:
+            continue
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            continue
+        name = key.decode().split("/", 1)[1]
+        slot = merged.setdefault(
+            name, {"type": payload["type"], "desc": payload.get("desc", ""),
+                   "bounds": payload.get("bounds"), "data": {}})
+        if slot["type"] != payload["type"]:
+            continue
+        for sample in payload["samples"]:
+            tag_key = tuple(tuple(t) for t in sample[0])
+            if payload["type"] == "counter":
+                slot["data"][tag_key] = slot["data"].get(tag_key, 0.0) + \
+                    sample[1]
+            elif payload["type"] == "gauge":
+                v, ts = sample[1], sample[2]
+                cur = slot["data"].get(tag_key)
+                if cur is None or ts >= cur[1]:
+                    slot["data"][tag_key] = (v, ts)
+            else:  # histogram
+                rec = slot["data"].get(tag_key)
+                if rec is None:
+                    slot["data"][tag_key] = list(sample[1])
+                else:
+                    for i, v in enumerate(sample[1]):
+                        rec[i] += v
+
+    def labels(tag_key, extra=None) -> str:
+        parts = [f'{k}="{v}"' for k, v in tag_key]
+        parts.extend(extra or ())
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    lines: List[str] = []
+    for name, slot in sorted(merged.items()):
+        kind = {"counter": "counter", "gauge": "gauge",
+                "histogram": "histogram"}[slot["type"]]
+        if slot["desc"]:
+            lines.append(f"# HELP {name} {slot['desc']}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tag_key, val in sorted(slot["data"].items()):
+            if slot["type"] == "counter":
+                lines.append(f"{name}{labels(tag_key)} {val}")
+            elif slot["type"] == "gauge":
+                lines.append(f"{name}{labels(tag_key)} {val[0]}")
+            else:
+                bounds = slot["bounds"] or []
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += val[i]
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{labels(tag_key, [f'le=\"{b}\"'])} {cum}")
+                cum += val[len(bounds)]
+                lines.append(
+                    f"{name}_bucket{labels(tag_key, ['le=\"+Inf\"'])} {cum}")
+                lines.append(f"{name}_sum{labels(tag_key)} {val[-2]}")
+                lines.append(f"{name}_count{labels(tag_key)} {val[-1]}")
+    return lines

@@ -1,0 +1,117 @@
+"""Autoscaler: demand-driven scale-up, idle scale-down, min/max workers.
+
+Reference behaviors covered: StandardAutoscaler.update
+(`python/ray/autoscaler/_private/autoscaler.py:368`),
+ResourceDemandScheduler.get_nodes_to_launch
+(`resource_demand_scheduler.py:169`), AutoscalingCluster test harness
+(`python/ray/cluster_utils.py:24`).
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.autoscaler import AutoscalingCluster, ResourceDemandScheduler
+
+
+# ---------------------------------------------------------------- unit level
+
+
+def test_demand_scheduler_bin_packing():
+    sched = ResourceDemandScheduler(
+        {"cpu4": {"resources": {"CPU": 4.0}},
+         "tpu_host": {"resources": {"CPU": 8.0, "TPU": 8.0}}},
+        max_workers=10)
+    # 6 one-CPU tasks, 1 free CPU on existing nodes -> 5 unfulfilled -> need
+    # two cpu4 nodes (4 + 1), not a TPU host.
+    out = sched.get_nodes_to_launch(
+        [{"CPU": 1.0}] * 6, [{"CPU": 1.0}], {"cpu4": 1})
+    assert out == {"cpu4": 2}
+
+
+def test_demand_scheduler_picks_fitting_type_and_caps():
+    sched = ResourceDemandScheduler(
+        {"cpu4": {"resources": {"CPU": 4.0}, "max_workers": 1},
+         "tpu_host": {"resources": {"CPU": 8.0, "TPU": 8.0}}},
+        max_workers=10)
+    # TPU demand must land on the TPU template even though cpu4 is smaller.
+    out = sched.get_nodes_to_launch([{"TPU": 4.0}], [], {})
+    assert out == {"tpu_host": 1}
+    # Per-type max_workers is respected.
+    out = sched.get_nodes_to_launch([{"CPU": 4.0}] * 3, [], {})
+    assert out.get("cpu4", 0) <= 1
+
+
+def test_demand_scheduler_infeasible_shape_ignored():
+    sched = ResourceDemandScheduler(
+        {"cpu4": {"resources": {"CPU": 4.0}}}, max_workers=10)
+    assert sched.get_nodes_to_launch([{"GPU": 1.0}], [], {}) == {}
+
+
+# ------------------------------------------------------------ cluster level
+
+
+def test_autoscaling_cluster_up_and_down():
+    import ray_tpu
+
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        worker_node_types={
+            "cpu2": {"resources": {"CPU": 2.0}, "min_workers": 0,
+                     "max_workers": 3, "object_store_mb": 32},
+        },
+        max_workers=3,
+        idle_timeout_s=1.5,
+        update_interval_s=0.2,
+    )
+    try:
+        cluster.connect()
+
+        @ray_tpu.remote(num_cpus=1)
+        def hold(i):
+            time.sleep(2.0)
+            return i
+
+        # 5 one-CPU tasks against a 1-CPU head: the queue shape forces
+        # scale-up; all tasks must complete on the grown cluster.
+        refs = [hold.remote(i) for i in range(5)]
+        out = ray_tpu.get(refs, timeout=60)
+        assert sorted(out) == [0, 1, 2, 3, 4]
+        assert cluster.autoscaler.num_launches >= 1
+
+        # After the burst the workers go idle and get reaped.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not cluster.worker_node_ids():
+                break
+            time.sleep(0.25)
+        assert cluster.worker_node_ids() == []
+        assert cluster.autoscaler.num_terminations >= 1
+    finally:
+        cluster.shutdown()
+
+
+def test_autoscaler_min_workers_floor():
+    cluster = AutoscalingCluster(
+        head_resources={"CPU": 1.0},
+        worker_node_types={
+            "cpu1": {"resources": {"CPU": 1.0}, "min_workers": 2,
+                     "max_workers": 2, "object_store_mb": 32},
+        },
+        max_workers=4,
+        idle_timeout_s=0.5,
+        update_interval_s=0.2,
+    )
+    try:
+        # min_workers nodes come up with no demand at all, and idle
+        # termination never dips below the floor.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(cluster.worker_node_ids()) >= 2:
+                break
+            time.sleep(0.25)
+        assert len(cluster.worker_node_ids()) == 2
+        time.sleep(2.0)  # well past idle_timeout
+        assert len(cluster.worker_node_ids()) == 2
+    finally:
+        cluster.shutdown()

@@ -208,6 +208,29 @@ def test_cluster_pg_remove_fails_queued(cluster):
         ray_tpu.get(ref, timeout=20)
 
 
+
+def test_worker_logs_forwarded_to_driver(cluster, capfd):
+    """Worker prints in cluster mode are tailed from per-worker log files
+    and pushed to the driver with a (pid, node) prefix (reference:
+    `python/ray/_private/log_monitor.py:102`)."""
+
+    @ray_tpu.remote
+    def shout():
+        print("LOG_CAPTURE_MARKER_77", flush=True)
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=30) == 1
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().out
+        if "LOG_CAPTURE_MARKER_77" in seen:
+            break
+        time.sleep(0.2)
+    assert "LOG_CAPTURE_MARKER_77" in seen
+    assert "node=" in seen
+
+
 class TestNodeFailure:
     """Node death: detection, task retry, actor failover (fresh cluster per
     test — killing nodes poisons the shared fixture)."""
@@ -254,3 +277,4 @@ class TestNodeFailure:
             assert len(alive) == 2
         finally:
             c.shutdown()
+

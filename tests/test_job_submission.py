@@ -1,0 +1,120 @@
+"""Job submission: submit/status/logs/stop/list against a real fake cluster.
+
+Reference behaviors: JobManager/JobSupervisor
+(`dashboard/modules/job/job_manager.py:516,140`), job SDK
+(`python/ray/job_submission/`).
+"""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 4})
+    c.wait_for_nodes(1)
+    yield c
+    # JobSubmissionClient attached the module's driver; detach it before
+    # the cluster goes away so later test modules start clean.
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    return JobSubmissionClient(cluster.address)
+
+
+def test_job_succeeds_and_logs(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info.entrypoint.endswith("\"print('hello from job')\"")
+    assert info.end_time is not None
+
+
+def test_job_entrypoint_attaches_to_cluster(client):
+    """The entrypoint's ray_tpu.init() auto-attaches via RAY_TPU_ADDRESS and
+    can run tasks on the SAME cluster that runs the supervisor."""
+    script = (
+        "import ray_tpu; ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('task says', ray_tpu.get(f.remote(21)))\n"
+    )
+    import shlex
+
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c " + shlex.quote(script))
+    assert client.wait_until_finished(job_id, timeout=90) == \
+        JobStatus.SUCCEEDED
+    assert "task says 42" in client.get_job_logs(job_id)
+
+
+def test_job_failure_reported(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; "
+        f"print('about to fail'); sys.exit(3)\"")
+    assert client.wait_until_finished(job_id, timeout=60) == JobStatus.FAILED
+    info = client.get_job_info(job_id)
+    assert "code 3" in info.message
+    assert "about to fail" in client.get_job_logs(job_id)
+
+
+def test_job_stop(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; "
+        f"print('sleeping', flush=True); time.sleep(60)\"")
+    # Wait for the subprocess to actually start before stopping it.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if "sleeping" in client.get_job_logs(job_id):
+            break
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, timeout=30) == JobStatus.STOPPED
+
+
+def test_job_env_vars_and_metadata(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import os; "
+        f"print('tag=' + os.environ['MY_TAG'])\"",
+        runtime_env={"env_vars": {"MY_TAG": "xyzzy"}},
+        metadata={"owner": "tests"})
+    assert client.wait_until_finished(job_id, timeout=60) == \
+        JobStatus.SUCCEEDED
+    assert "tag=xyzzy" in client.get_job_logs(job_id)
+    assert client.get_job_info(job_id).metadata == {"owner": "tests"}
+
+
+def test_list_and_tail_and_delete(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('A'); print('B')\"",
+        submission_id="job-listme")
+    ids = [j.submission_id for j in client.list_jobs()]
+    assert "job-listme" in ids
+    chunks = "".join(client.tail_job_logs(job_id))
+    assert "A" in chunks and "B" in chunks
+    assert client.get_job_status(job_id) in JobStatus.TERMINAL
+    assert client.delete_job(job_id)
+    with pytest.raises(ValueError):
+        client.get_job_info(job_id)
+
+
+def test_duplicate_submission_id_rejected(client):
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('x')\"")
+    client.wait_until_finished(job_id, timeout=60)
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="echo hi", submission_id=job_id)
