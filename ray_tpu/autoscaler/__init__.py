@@ -221,12 +221,18 @@ class StandardAutoscaler:
                 if counts.get(t, 0) <= floor:
                     continue
                 if m["idle_s"] >= self.idle_timeout_s:
-                    # Drain from GCS first so no new work lands mid-kill.
+                    # Drain first (placement skips the node but heartbeats
+                    # keep succeeding, so the raylet does NOT re-register),
+                    # then kill, then clean up membership.
+                    try:
+                        self._gcs.drain_node(nid)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.provider.terminate_node(nid)
                     try:
                         self._gcs.unregister_node(nid)
                     except Exception:  # noqa: BLE001
                         pass
-                    self.provider.terminate_node(nid)
                     counts[t] -= 1
                     self.num_terminations += 1
 

@@ -116,6 +116,16 @@ class GcsCore:
     def unregister_node(self, node_id: str):
         self._mark_dead(node_id, "node drained")
 
+    def drain_node(self, node_id: str):
+        """Mark a node as draining: no new task/PG placement lands on it,
+        but it stays alive (and its heartbeats keep succeeding, so it does
+        not re-register) until actually terminated (reference: the
+        autoscaler's DrainNode RPC before instance termination)."""
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is not None:
+                info["draining"] = True
+
     def heartbeat(self, node_id: str, resources_available: Dict[str, float],
                   queue_len: int = 0, pending_shapes=None) -> bool:
         """``pending_shapes`` is the node's unfulfilled resource demand:
@@ -253,7 +263,8 @@ class GcsCore:
         best, best_score = None, None
         with self._lock:
             for nid, info in self._nodes.items():
-                if not info["alive"] or nid in exclude:
+                if not info["alive"] or nid in exclude \
+                        or info.get("draining"):
                     continue
                 avail = info["resources_available"]
                 if all(avail.get(k, 0.0) + 1e-9 >= v
@@ -268,7 +279,7 @@ class GcsCore:
         with self._lock:
             return [
                 nid for nid, info in self._nodes.items()
-                if info["alive"] and all(
+                if info["alive"] and not info.get("draining") and all(
                     info["resources_total"].get(k, 0.0) + 1e-9 >= v
                     for k, v in resources.items())
             ]
@@ -308,9 +319,11 @@ class GcsCore:
         places (fragments then pend locally until resources free)."""
         with self._lock:
             nodes = {nid: dict(info["resources_available"])
-                     for nid, info in self._nodes.items() if info["alive"]}
+                     for nid, info in self._nodes.items()
+                     if info["alive"] and not info.get("draining")}
             totals = {nid: dict(info["resources_total"])
-                      for nid, info in self._nodes.items() if info["alive"]}
+                      for nid, info in self._nodes.items()
+                      if info["alive"] and not info.get("draining")}
         if not nodes:
             return None
 
@@ -569,7 +582,7 @@ class GcsCore:
 
 _OPS = {
     "register_node", "unregister_node", "heartbeat", "nodes", "get_node",
-    "place_task", "feasible_nodes", "load_metrics",
+    "place_task", "feasible_nodes", "load_metrics", "drain_node",
     "kv_put", "kv_get", "kv_del", "kv_keys",
     "put_function", "get_function",
     "register_actor", "update_actor", "remove_actor", "get_actor",

@@ -616,6 +616,7 @@ class Raylet:
         if stdout is not None:
             stdout.close()  # child keeps its copy
             self._worker_log_tails[log_path]["pid"] = proc.pid
+            self._worker_log_tails[log_path]["proc"] = proc
         self._procs.append(proc)
         self._unregistered.append((proc, profile))
         if not self._health_timer_armed:
@@ -635,23 +636,30 @@ class Raylet:
             except OSError:
                 self._worker_log_tails.pop(path, None)
                 continue
+            proc = tail.get("proc")
+            worker_dead = proc is not None and proc.poll() is not None
             if not data:
+                if worker_dead:
+                    # fully drained a dead worker's file: stop tailing it
+                    self._worker_log_tails.pop(path, None)
                 continue
-            # Only ship complete lines; keep the partial tail for next tick.
-            cut = data.rfind(b"\n")
-            if cut < 0:
+            # Ship complete lines; keep the partial tail for the next tick
+            # unless the worker already exited (then flush everything).
+            cut = len(data) if worker_dead else data.rfind(b"\n") + 1
+            if cut <= 0:
                 continue
-            tail["pos"] += cut + 1
+            tail["pos"] += cut
             lines = data[:cut].decode("utf-8", "replace").splitlines()
-            if not drivers or not lines:
-                continue
-            msg = {"t": "log", "node_id": self.node_id,
-                   "pid": tail["pid"], "lines": lines}
-            for conn in drivers:
-                try:
-                    conn.send(msg)
-                except OSError:
-                    pass
+            if drivers and lines:
+                msg = {"t": "log", "node_id": self.node_id,
+                       "pid": tail["pid"], "lines": lines}
+                for conn in drivers:
+                    try:
+                        conn.send(msg)
+                    except OSError:
+                        pass
+            if worker_dead:
+                self._worker_log_tails.pop(path, None)
         if not self._shutdown:
             self.add_timer(0.3, self._pump_worker_logs)
 

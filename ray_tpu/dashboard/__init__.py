@@ -170,17 +170,20 @@ class DashboardHead:
     # -------------------------------------------------------------- index
 
     def _index(self) -> str:
+        import html as _html
+
         res = self._cluster_resources()
         nodes = self._nodes()
         jobs = self._jobs()
         rows = "".join(
-            f"<tr><td>{n['node_id'][:12]}</td>"
+            f"<tr><td>{_html.escape(n['node_id'][:12])}</td>"
             f"<td>{'ALIVE' if n['alive'] else 'DEAD'}</td>"
-            f"<td>{json.dumps(n['resources_total'])}</td></tr>"
+            f"<td>{_html.escape(json.dumps(n['resources_total']))}</td></tr>"
             for n in nodes)
         job_rows = "".join(
-            f"<tr><td>{j['submission_id']}</td><td>{j['status']}</td>"
-            f"<td><code>{j['entrypoint'][:80]}</code></td></tr>"
+            f"<tr><td>{_html.escape(j['submission_id'])}</td>"
+            f"<td>{_html.escape(j['status'])}</td>"
+            f"<td><code>{_html.escape(j['entrypoint'][:80])}</code></td></tr>"
             for j in jobs)
         return f"""<!doctype html><html><head><title>ray_tpu dashboard</title>
 <style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:

@@ -111,6 +111,12 @@ class _JobSupervisor:
     def logs(self, offset: int = 0) -> str:
         return "".join(self._log_chunks[offset:])
 
+    def logs_since(self, offset: int):
+        """Atomic (text, next_offset) — the tail cursor and the text come
+        from one snapshot, so concurrent appends are never skipped."""
+        chunks = self._log_chunks[offset:]
+        return "".join(chunks), offset + len(chunks)
+
     def log_chunk_count(self) -> int:
         return len(self._log_chunks)
 
@@ -234,10 +240,9 @@ class JobSubmissionClient:
             sup = self._supervisor(submission_id)
             if sup is not None:
                 try:
-                    chunk = self._ray.get(sup.logs.remote(offset))
-                    n = self._ray.get(sup.log_chunk_count.remote())
+                    chunk, offset = self._ray.get(
+                        sup.logs_since.remote(offset))
                     if chunk:
-                        offset = n
                         yield chunk
                 except Exception:  # noqa: BLE001
                     pass

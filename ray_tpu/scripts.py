@@ -114,7 +114,9 @@ def cmd_job(args) -> int:
 
     client = JobSubmissionClient(args.address)
     if args.job_command == "submit":
-        entrypoint = " ".join(args.entrypoint)
+        import shlex
+
+        entrypoint = shlex.join(args.entrypoint)
         job_id = client.submit_job(
             entrypoint=entrypoint, submission_id=args.submission_id)
         print(f"submitted {job_id}")

@@ -231,8 +231,12 @@ def render_kv_metrics(gcs) -> List[str]:
                     for i, v in enumerate(sample[1]):
                         rec[i] += v
 
+    def esc(v: str) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+
     def labels(tag_key, extra=None) -> str:
-        parts = [f'{k}="{v}"' for k, v in tag_key]
+        parts = [f'{k}="{esc(v)}"' for k, v in tag_key]
         parts.extend(extra or ())
         return "{" + ",".join(parts) + "}" if parts else ""
 
