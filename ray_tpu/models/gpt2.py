@@ -362,19 +362,23 @@ def _cast_weights(params, dtype):
         if x.dtype == jnp.float32 and x.ndim >= 2 else x, params)
 
 
-def make_train_step(cfg: GPT2Config, optimizer, pp_microbatches: int = 2):
+def make_train_step(cfg: GPT2Config, optimizer, pp_microbatches: int = 2,
+                    xent_chunks: int = 0):
     """Returns train_step(params, opt_state, batch) -> (params, opt_state,
     metrics) — jit it with the appropriate shardings.  Works for dense,
     MoE, and pipeline-stacked params alike.
 
     Mixed precision: f32 master params; the loss closure casts the weight
     tree to ``cfg.compute_dtype`` once (see _cast_weights), autodiff flows
-    back through the cast, so grads and the adamw update stay f32."""
+    back through the cast, so grads and the adamw update stay f32.
+
+    ``xent_chunks>0`` enables the chunked fused lm-head cross-entropy for
+    HBM-tight configs (see loss_fn)."""
 
     def train_step(params, opt_state, batch):
         def loss_cast(p):
             return loss_fn(_cast_weights(p, cfg.compute_dtype), batch, cfg,
-                           pp_microbatches)
+                           pp_microbatches, xent_chunks)
 
         loss, grads = jax.value_and_grad(loss_cast)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)

@@ -20,8 +20,13 @@ combo).
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, make_vtrace_fn
+from ray_tpu.rllib.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.models import (
     cnn_forward,
     init_cnn_policy,
@@ -34,8 +39,9 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "EnvRunner", "Impala", "ImpalaConfig",
-    "PPO", "PPOConfig", "SampleBatch", "compute_gae", "cnn_forward",
-    "init_cnn_policy", "init_mlp_policy", "make_vtrace_fn", "mlp_forward",
-    "policy_forward", "sample_action",
+    "Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "EnvRunner",
+    "Impala", "ImpalaConfig", "PPO", "PPOConfig",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "SampleBatch",
+    "compute_gae", "cnn_forward", "init_cnn_policy", "init_mlp_policy",
+    "make_vtrace_fn", "mlp_forward", "policy_forward", "sample_action",
 ]

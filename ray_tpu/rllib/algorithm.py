@@ -100,7 +100,8 @@ class Algorithm(Trainable):
         )(EnvRunner)
         self.env_runners = [
             runner_cls.remote(cfg.env_creator, cfg.num_envs_per_runner,
-                              cfg.rollout_length, None, seed=cfg.seed + i)
+                              cfg.rollout_length, None, seed=cfg.seed + i,
+                              **self.runner_kwargs())
             for i in range(cfg.num_env_runners)
         ]
         self._total_env_steps = 0
@@ -109,6 +110,10 @@ class Algorithm(Trainable):
         self.sync_weights()
 
     # ---- override points -----------------------------------------------
+
+    def runner_kwargs(self) -> Dict[str, Any]:
+        """Extra EnvRunner kwargs (e.g. DQN's epsilon-greedy action_fn)."""
+        return {}
 
     def build_learner(self):
         raise NotImplementedError

@@ -1,0 +1,210 @@
+"""DQN — off-policy Q-learning with replay and target network.
+
+Reference analogue: `rllib/algorithms/dqn/dqn.py` (double DQN + PER
+defaults).  TPU-first: one jitted update (double-Q target, huber TD,
+importance weights) on the learner chip; epsilon-greedy rollouts on CPU
+EnvRunner actors; replay stays host-side numpy
+(`ray_tpu/rllib/replay_buffers.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS,
+)
+
+__all__ = ["DQNConfig", "DQN", "dqn_action_fn"]
+
+
+def dqn_action_fn(weights, obs, key):
+    """Epsilon-greedy over Q-values; epsilon rides in the weights payload
+    so the learner's anneal schedule reaches the runners with every
+    sync_weights.  Matches the EnvRunner action_fn contract
+    (-> action, logp, value; logp/value are unused placeholders here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import policy_forward
+
+    q, _ = policy_forward(weights["params"], obs)
+    greedy = jnp.argmax(q, axis=-1)
+    k1, k2 = jax.random.split(key)
+    rand = jax.random.randint(k1, greedy.shape, 0, q.shape[-1])
+    explore = jax.random.uniform(k2, greedy.shape) < weights["epsilon"]
+    action = jnp.where(explore, rand, greedy)
+    zeros = jnp.zeros(greedy.shape, jnp.float32)
+    return action, zeros, zeros
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 50_000
+        self.train_batch_size = 64
+        self.learning_starts = 1_000
+        self.num_updates_per_iter = 32
+        self.target_network_update_freq = 500   # env steps
+        self.double_q = True
+        self.prioritized_replay = True
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_anneal_steps = 10_000
+        self.hidden = (64, 64)
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(Algorithm):
+    _config_cls = DQNConfig
+
+    def runner_kwargs(self) -> Dict[str, Any]:
+        return {"action_fn": dqn_action_fn, "store_next_obs": True}
+
+    # ------------------------------------------------------------- learner
+
+    def build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import init_mlp_policy, policy_forward
+        from ray_tpu.rllib.replay_buffers import (
+            PrioritizedReplayBuffer, ReplayBuffer,
+        )
+
+        cfg = self.algo_config
+        env = cfg.env_creator()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+
+        self.params = init_mlp_policy(
+            jax.random.PRNGKey(cfg.seed), obs_dim, num_actions, cfg.hidden)
+        # real copies: params is donated into the jitted update, so the
+        # target tree must not alias its buffers
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._opt = optax.adam(cfg.lr)
+        self.opt_state = self._opt.init(self.params)
+        self.buffer = (
+            PrioritizedReplayBuffer(cfg.buffer_size, cfg.per_alpha,
+                                    cfg.per_beta, seed=cfg.seed)
+            if cfg.prioritized_replay
+            else ReplayBuffer(cfg.buffer_size, seed=cfg.seed))
+        self._steps_since_target_sync = 0
+        gamma, double_q = cfg.gamma, cfg.double_q
+
+        def update(params, target_params, opt_state, batch):
+            def loss_fn(params):
+                q_all, _ = policy_forward(params, batch[OBS])
+                q = jnp.take_along_axis(
+                    q_all, batch[ACTIONS][:, None], axis=-1)[:, 0]
+                qt_all, _ = policy_forward(target_params, batch[NEXT_OBS])
+                if double_q:
+                    # action chosen by the ONLINE net, valued by the target
+                    qn_all, _ = policy_forward(params, batch[NEXT_OBS])
+                    a_star = jnp.argmax(qn_all, axis=-1)
+                else:
+                    a_star = jnp.argmax(qt_all, axis=-1)
+                q_next = jnp.take_along_axis(
+                    qt_all, a_star[:, None], axis=-1)[:, 0]
+                target = batch[REWARDS] + gamma * (1.0 - batch[DONES]) \
+                    * jax.lax.stop_gradient(q_next)
+                td = q - jax.lax.stop_gradient(target)
+                huber = jnp.where(jnp.abs(td) <= 1.0, 0.5 * td ** 2,
+                                  jnp.abs(td) - 0.5)
+                loss = jnp.mean(batch["weights"] * huber)
+                return loss, jnp.abs(td)
+
+            (loss, td_abs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td_abs
+
+        self._update = jax.jit(update, donate_argnums=(0, 2))
+
+    # ---------------------------------------------------------------- step
+
+    def epsilon(self) -> float:
+        cfg = self.algo_config
+        frac = min(1.0, self._total_env_steps
+                   / max(1, cfg.epsilon_anneal_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def get_weights(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "epsilon": np.float32(self.epsilon())}
+
+    def set_weights(self, weights):
+        import jax
+
+        self.params = weights["params"]
+        self.target_params = jax.tree.map(np.array,
+                                          weights.get("target_params",
+                                                      weights["params"]))
+        self.opt_state = self._opt.init(self.params)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.algo_config
+        rollouts = self.synchronous_parallel_sample()
+        steps_this_iter = 0
+        for ro in rollouts:
+            b = ro["batch"]
+            n = len(b[REWARDS])
+            steps_this_iter += n
+            self.buffer.add({
+                OBS: b[OBS], ACTIONS: b[ACTIONS], REWARDS: b[REWARDS],
+                NEXT_OBS: b[NEXT_OBS], DONES: b[DONES],
+            })
+        self._steps_since_target_sync += steps_this_iter
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                sample = self.buffer.sample(cfg.train_batch_size)
+                if "weights" not in sample:
+                    sample["weights"] = np.ones(
+                        cfg.train_batch_size, np.float32)
+                idx = sample.pop("batch_indexes")
+                self.params, self.opt_state, loss, td_abs = self._update(
+                    self.params, self.target_params, self.opt_state, sample)
+                losses.append(float(loss))
+                if hasattr(self.buffer, "update_priorities"):
+                    self.buffer.update_priorities(idx, np.asarray(td_abs))
+            if self._steps_since_target_sync \
+                    >= cfg.target_network_update_freq:
+                self.target_params = jax.tree.map(np.array, self.params)
+                self._steps_since_target_sync = 0
+        self.sync_weights()
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self.epsilon(),
+            "buffer_size": len(self.buffer),
+            "_steps_this_iter": steps_this_iter,
+        }
+
+    def save_checkpoint(self):
+        import jax
+
+        return {
+            "weights": {
+                "params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray,
+                                              self.target_params),
+            },
+            "total_env_steps": self._total_env_steps,
+        }

@@ -29,14 +29,26 @@ from ray_tpu.rllib.sample_batch import (
 
 class EnvRunner:
     def __init__(self, env_creator, num_envs: int, rollout_length: int,
-                 policy_init, seed: int = 0):
+                 policy_init, seed: int = 0,
+                 action_fn=None, store_next_obs: bool = False):
         """env_creator() -> gymnasium.Env; policy_init(rng, obs_dim,
         num_actions) -> params (only used for shape checks on the runner —
-        weights always come from the learner via set_weights)."""
+        weights always come from the learner via set_weights).
+
+        ``action_fn(weights, obs, key) -> (action, logp, value)`` replaces
+        the default categorical-policy sampler (e.g. DQN's epsilon-greedy;
+        ``weights`` is whatever the learner ships via set_weights, so
+        schedules like epsilon can ride along).  ``store_next_obs`` adds
+        NEXT_OBS to the batch (off-policy learners need (s, a, r, s')
+        transitions; on-policy GAE does not)."""
         import gymnasium as gym
         import jax
 
         from ray_tpu.rllib.models import sample_action
+
+        if action_fn is not None:
+            sample_action = action_fn
+        self._store_next_obs = store_next_obs
 
         # SAME_STEP autoreset (classic semantics): a terminated env returns
         # the reset obs in the same step() call.  gymnasium >= 1.0 defaults
@@ -95,6 +107,8 @@ class EnvRunner:
         val_buf = np.empty((T, B), np.float32)
         rew_buf = np.empty((T, B), np.float32)
         done_buf = np.empty((T, B), np.float32)
+        next_obs_buf = (np.empty_like(obs_buf)
+                        if self._store_next_obs else None)
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
@@ -121,6 +135,11 @@ class EnvRunner:
                     (float(self._ep_return[i]), int(self._ep_len[i])))
                 self._ep_return[i] = 0.0
                 self._ep_len[i] = 0
+            if next_obs_buf is not None:
+                # SAME_STEP autoreset returns the reset obs after a done;
+                # that's fine for the Q target — done=1 masks the bootstrap.
+                next_obs_buf[t] = np.asarray(next_obs).astype(
+                    next_obs_buf.dtype)
             self._obs = np.asarray(next_obs)
             if self._obs.dtype != np.uint8:
                 self._obs = self._obs.astype(np.float32)
@@ -139,6 +158,9 @@ class EnvRunner:
             REWARDS: rew_buf.reshape(T * B),
             DONES: done_buf.reshape(T * B),
         })
+        if next_obs_buf is not None:
+            batch[NEXT_OBS] = next_obs_buf.reshape(
+                (T * B,) + next_obs_buf.shape[2:])
         completed, self._completed = self._completed, []
         return {
             "batch": batch,

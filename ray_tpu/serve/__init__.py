@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 from ray_tpu.serve.batching import batch, batch_sizes_of
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE, ServeController
 from ray_tpu.serve.http_proxy import PROXY_NAME, HTTPProxy
 from ray_tpu.serve.router import DeploymentHandle
@@ -30,6 +31,7 @@ __all__ = [
     "deployment", "run", "start", "shutdown", "delete", "status",
     "get_deployment_handle", "get_app_handle", "Deployment", "Application",
     "AutoscalingConfig", "DeploymentHandle", "batch", "batch_sizes_of",
+    "get_multiplexed_model_id", "multiplexed",
 ]
 
 _state_lock = threading.Lock()

@@ -44,10 +44,14 @@ class HTTPProxy:
                 from urllib.parse import parse_qs
 
                 query = (self.path.split("?", 1) + [""])[1]
+                # model id: header (reference contract) or query param
+                model_id = self.headers.get(
+                    "serve_multiplexed_model_id",
+                    parse_qs(query).get("model_id", [""])[0])
                 if parse_qs(query).get("stream", ["0"])[0] == "1":
-                    return self._dispatch_stream(body)
+                    return self._dispatch_stream(body, model_id)
                 try:
-                    status, payload = proxy._handle(self.path, body)
+                    status, payload = proxy._handle(self.path, body, model_id)
                 except Exception as e:  # noqa: BLE001
                     status, payload = 500, json.dumps(
                         {"error": str(e)}).encode()
@@ -57,12 +61,13 @@ class HTTPProxy:
                 self.end_headers()
                 self.wfile.write(payload)
 
-            def _dispatch_stream(self, body: Optional[bytes]):
+            def _dispatch_stream(self, body: Optional[bytes],
+                                 model_id: str = ""):
                 """?stream=1: chunked NDJSON, one line per yielded item —
                 items flush as the replica produces them (streaming
                 generator returns underneath)."""
                 try:
-                    items = proxy._handle_stream(self.path, body)
+                    items = proxy._handle_stream(self.path, body, model_id)
                     first = next(items, _SENTINEL)
                 except Exception as e:  # noqa: BLE001
                     payload = json.dumps({"error": str(e)}).encode()
@@ -105,7 +110,8 @@ class HTTPProxy:
 
     # ----------------------------------------------------------------
 
-    def _handle(self, path: str, body: Optional[bytes]):
+    def _handle(self, path: str, body: Optional[bytes],
+                model_id: str = ""):
         import ray_tpu
 
         path = path.split("?", 1)[0]
@@ -119,6 +125,8 @@ class HTTPProxy:
             return 404, json.dumps({"error": f"no route for {path}"}).encode()
         deployment = match[1]
         handle = self._get_handle(deployment)
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         request = json.loads(body) if body else None
         result = ray_tpu.get(handle.remote(request), timeout=120)
         return 200, json.dumps(result, default=str).encode()
@@ -134,7 +142,8 @@ class HTTPProxy:
                         match = (prefix, deployment)
         return match
 
-    def _handle_stream(self, path: str, body: Optional[bytes]):
+    def _handle_stream(self, path: str, body: Optional[bytes],
+                       model_id: str = ""):
         """Yield the deployment's streamed items (resolved values)."""
         import ray_tpu
 
@@ -142,6 +151,8 @@ class HTTPProxy:
         if match is None:
             raise ValueError(f"no route for {path}")
         handle = self._get_handle(match[1], stream=True)
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         request = json.loads(body) if body else None
         for ref in handle.remote(request):
             yield ray_tpu.get(ref, timeout=120)

@@ -33,10 +33,14 @@ class Replica:
 
     # ------------------------------------------------------------- serving
 
-    def handle_request(self, request: Any, method: str = "__call__"):
+    def handle_request(self, request: Any, method: str = "__call__",
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(multiplexed_model_id)
         try:
             if method == "__call__" and callable(self._callable):
                 fn = self._callable  # plain function or __call__ instance
@@ -44,17 +48,24 @@ class Replica:
                 fn = getattr(self._callable, method)
             return fn(request)
         finally:
+            from ray_tpu.serve.multiplex import _model_id_ctx
+
+            _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_stream(self, request: Any, method: str = "__call__"):
+    def handle_request_stream(self, request: Any, method: str = "__call__",
+                              multiplexed_model_id: str = ""):
         """Generator variant (invoked with num_returns="streaming"): the
         user callable returns an iterator whose items stream to the caller
         as they are produced (reference: Serve streaming responses over
         streaming generator returns)."""
+        from ray_tpu.serve.multiplex import _model_id_ctx, _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(multiplexed_model_id)
         try:
             if method == "__call__" and callable(self._callable):
                 fn = self._callable
@@ -63,8 +74,21 @@ class Replica:
             for item in fn(request):
                 yield item
         finally:
+            _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    def multiplexed_model_ids(self) -> list:
+        """Model ids currently loaded by any @multiplexed method on this
+        replica (for tests/state; the reference broadcasts these to the
+        router for affinity)."""
+        out = []
+        cal = self._callable
+        for name in dir(type(cal)):
+            attr = getattr(type(cal), name, None)
+            if callable(attr) and getattr(attr, "_serve_multiplexed", False):
+                out.extend(attr._serve_model_ids(cal))
+        return out
 
     # ------------------------------------------------------------- control
 

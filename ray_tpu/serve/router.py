@@ -84,6 +84,7 @@ class _DeploymentRouting:
                 if routing["deployments"].get(self.deployment) is None:
                     with _routing_lock:
                         _routing.pop(self.deployment, None)
+                    _prune_affinity(self.deployment)
                     return  # deployment deleted: stop listening
                 self.apply(routing)
             except Exception:  # noqa: BLE001 controller restart/teardown
@@ -108,12 +109,30 @@ def _routing_for(deployment: str) -> _DeploymentRouting:
         return entry
 
 
+#: (deployment, model_id) -> replica handle that served it last.  Model
+#: affinity for multiplexed deployments (reference: the router's
+#: multiplexed-model-id replica ranking): repeat requests for the same
+#: model prefer the replica that already has it loaded.
+_model_affinity: dict = {}
+_model_affinity_lock = threading.Lock()
+
+
+def _prune_affinity(deployment: str):
+    """Drop every affinity entry of a deleted deployment — entries (and
+    their dead replica handles) would otherwise accumulate forever across
+    deploy/delete cycles."""
+    with _model_affinity_lock:
+        for key in [k for k in _model_affinity if k[0] == deployment]:
+            del _model_affinity[key]
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self._deployment = deployment_name
         self._method = method_name
         self._stream = stream
+        self._model_id = multiplexed_model_id
 
     # ------------------------------------------------------------- plumbing
 
@@ -168,21 +187,55 @@ class DeploymentHandle:
 
     # ------------------------------------------------------------- calling
 
+    def _pick_replica_affine(self):
+        """Model affinity: prefer the replica that last served this model
+        (it has the model in its LRU) unless it is heavily loaded relative
+        to a power-of-two alternative."""
+        import ray_tpu
+
+        key = (self._deployment, self._model_id)
+        with _model_affinity_lock:
+            cached = _model_affinity.get(key)
+        routing = self._routing
+        self._refresh()
+        with routing.lock:
+            alive = set(routing.replicas)
+        if cached is not None and cached in alive:
+            try:
+                q = ray_tpu.get(cached.get_queue_len.remote(), timeout=5.0)
+                if q <= 4:  # loaded-model locality beats a cold load
+                    return cached
+            except Exception:  # noqa: BLE001 — replica gone
+                pass
+        replica = self._pick_replica()
+        with _model_affinity_lock:
+            _model_affinity[key] = replica
+        return replica
+
     def remote(self, request: Any = None):
         """Dispatch; returns an ObjectRef (resolve with ray_tpu.get), or an
         ObjectRefGenerator when the handle has ``stream=True``."""
-        replica = self._pick_replica()
+        if self._model_id:
+            replica = self._pick_replica_affine()
+        else:
+            replica = self._pick_replica()
         if self._stream:
             return replica.handle_request_stream.options(
-                num_returns="streaming").remote(request, self._method)
-        return replica.handle_request.remote(request, self._method)
+                num_returns="streaming").remote(request, self._method,
+                                                self._model_id)
+        return replica.handle_request.remote(request, self._method,
+                                             self._model_id)
 
     def options(self, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None,
+                ) -> "DeploymentHandle":
         return DeploymentHandle(
             self._deployment,
             self._method if method_name is None else method_name,
-            self._stream if stream is None else stream)
+            self._stream if stream is None else stream,
+            self._model_id if multiplexed_model_id is None
+            else multiplexed_model_id)
 
     @property
     def method(self):
@@ -191,7 +244,7 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self._deployment, self._method,
-                                   self._stream))
+                                   self._stream, self._model_id))
 
     def __repr__(self):
         return f"DeploymentHandle({self._deployment!r})"

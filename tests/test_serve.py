@@ -382,3 +382,70 @@ def test_handle_streaming(served):
             for r in h.options(stream=True).remote({"n": 3})]
     assert vals == [0, 10, 20]
     serve.delete("hstream")
+
+
+class TestMultiplexing:
+    """Model multiplexing (reference: `python/ray/serve/multiplex.py`):
+    @serve.multiplexed LRU loading, per-request model id, replica
+    affinity."""
+
+    def test_multiplexed_lru_and_model_id(self, served):
+        @serve.deployment(num_replicas=1)
+        class MultiModel:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                return {"id": model_id, "scale": float(len(model_id))}
+
+            def __call__(self, request):
+                model = self.get_model()
+                return {"model": model["id"],
+                        "y": model["scale"] * (request or {}).get("x", 1)}
+
+        serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+        port = serve.http_port()
+
+        def post(model_id, x):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/mux",
+                data=json.dumps({"x": x}).encode(),
+                headers={"serve_multiplexed_model_id": model_id,
+                         "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        assert post("aa", 3) == {"model": "aa", "y": 6.0}
+        assert post("bbb", 2) == {"model": "bbb", "y": 6.0}
+        assert post("aa", 1) == {"model": "aa", "y": 2.0}  # cache hit
+        assert post("cccc", 1)["model"] == "cccc"  # evicts LRU ("bbb")
+        handle = serve.get_deployment_handle("MultiModel")
+        replica = handle._pick_replica()
+        ids = ray_tpu.get(replica.multiplexed_model_ids.remote(), timeout=30)
+        # capacity 2: "bbb" was least-recently-used and evicted
+        assert sorted(ids) == ["aa", "cccc"]
+        serve.delete("mux")
+
+    def test_handle_options_model_id_affinity(self, served):
+        @serve.deployment(num_replicas=2)
+        class M:
+            @serve.multiplexed(max_num_models_per_replica=1)
+            def load(self, model_id: str):
+                import os
+
+                return {"pid": os.getpid(), "id": model_id}
+
+            def __call__(self, request):
+                m = self.load()
+                return {"pid": m["pid"], "model": m["id"]}
+
+        serve.run(M.bind(), name="mux2", route_prefix="/mux2")
+        handle = serve.get_deployment_handle("M")
+        h = handle.options(multiplexed_model_id="modelA")
+        first = ray_tpu.get(h.remote({}), timeout=60)
+        assert first["model"] == "modelA"
+        # affinity: repeat requests for the same model hit the SAME replica
+        pids = {ray_tpu.get(h.remote({}), timeout=60)["pid"]
+                for _ in range(6)}
+        assert pids == {first["pid"]}
+        # get_multiplexed_model_id() outside a request context is empty
+        assert serve.get_multiplexed_model_id() == ""
+        serve.delete("mux2")
