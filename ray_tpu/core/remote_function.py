@@ -101,6 +101,14 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (reference: ``RemoteFunction.bind`` →
+        `python/ray/dag/dag_node.py`); execute with ``node.execute()`` or
+        run durably via ``ray_tpu.workflow.run``."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{self.__name__}' cannot be called directly; "

@@ -8,6 +8,16 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
                                   GroupedData)
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    Preprocessor,
+    StandardScaler,
+)
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -22,8 +32,10 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
-    "ActorPoolStrategy", "Block", "BlockAccessor", "BlockMetadata",
-    "Dataset", "DataIterator", "GroupedData",
+    "ActorPoolStrategy", "BatchMapper", "Block", "BlockAccessor",
+    "BlockMetadata", "Chain", "Concatenator", "Dataset", "DataIterator",
+    "GroupedData", "LabelEncoder", "MinMaxScaler", "OneHotEncoder",
+    "Preprocessor", "StandardScaler",
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files",

@@ -99,6 +99,12 @@ def multiplexed(max_num_models_per_replica: int = 3):
         def inner(self_obj, model_id: str = None):  # noqa: RUF013
             if model_id is None:
                 model_id = get_multiplexed_model_id()
+            if not model_id:
+                raise ValueError(
+                    "no multiplexed model id for this request — send the "
+                    "'serve_multiplexed_model_id' header (or model_id "
+                    "query param), or set it via handle.options("
+                    "multiplexed_model_id=...)")
             return cache_for(self_obj).get(self_obj, model_id)
 
         inner._serve_multiplexed = True

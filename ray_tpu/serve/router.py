@@ -14,6 +14,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, List, Optional
 
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE
@@ -112,9 +113,11 @@ def _routing_for(deployment: str) -> _DeploymentRouting:
 #: (deployment, model_id) -> replica handle that served it last.  Model
 #: affinity for multiplexed deployments (reference: the router's
 #: multiplexed-model-id replica ranking): repeat requests for the same
-#: model prefer the replica that already has it loaded.
-_model_affinity: dict = {}
+#: model prefer the replica that already has it loaded.  Bounded LRU: a
+#: rotating model-id space must not grow process memory forever.
+_model_affinity: "OrderedDict" = OrderedDict()
 _model_affinity_lock = threading.Lock()
+_MODEL_AFFINITY_CAP = 4096
 
 
 def _prune_affinity(deployment: str):
@@ -196,6 +199,8 @@ class DeploymentHandle:
         key = (self._deployment, self._model_id)
         with _model_affinity_lock:
             cached = _model_affinity.get(key)
+            if cached is not None:
+                _model_affinity.move_to_end(key)
         routing = self._routing
         self._refresh()
         with routing.lock:
@@ -210,6 +215,9 @@ class DeploymentHandle:
         replica = self._pick_replica()
         with _model_affinity_lock:
             _model_affinity[key] = replica
+            _model_affinity.move_to_end(key)
+            while len(_model_affinity) > _MODEL_AFFINITY_CAP:
+                _model_affinity.popitem(last=False)
         return replica
 
     def remote(self, request: Any = None):

@@ -22,10 +22,14 @@ from ray_tpu.train.session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     generate_variants,
     grid_search,
@@ -42,7 +46,8 @@ __all__ = [
     "get_checkpoint", "Trainable", "with_parameters", "with_resources",
     "grid_search", "uniform", "loguniform", "randint", "choice",
     "sample_from", "generate_variants", "TrialScheduler", "FIFOScheduler",
-    "ASHAScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "TPESearcher",
 ]
 
 
@@ -59,6 +64,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional["Searcher"] = None
     resources_per_trial: Optional[Dict[str, float]] = None
     stop: Optional[Dict[str, float]] = None
     time_budget_s: Optional[float] = None

@@ -184,3 +184,44 @@ class PopulationBasedTraining(TrialScheduler):
         self.exploit_donor_id = donor_id
         self.num_perturbations += 1
         return EXPLOIT
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    the other trials' RUNNING-AVERAGE results at the same step (reference:
+    `tune/schedulers/median_stopping_rule.py`, from Google Vizier)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 4, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # trial_id -> list of normalized results (in report order)
+        self._results: Dict[str, List[float]] = {}
+
+    def _norm(self, v: float) -> float:
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result):
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        hist = self._results.setdefault(trial.trial_id, [])
+        hist.append(self._norm(v))
+        if t < self.grace_period:
+            return CONTINUE
+        # running average of every OTHER trial up to this step count
+        others = [sum(h[:len(hist)]) / min(len(h), len(hist))
+                  for tid, h in self._results.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if max(hist) < median:
+            return STOP
+        return CONTINUE

@@ -101,6 +101,12 @@ def _set_path(d: dict, path, value):
     d[path[-1]] = value
 
 
+def _get_path(d: dict, path):
+    for k in path:
+        d = d[k]
+    return d
+
+
 def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
                       seed: Optional[int] = None) -> List[Dict[str, Any]]:
     """Expand grid_search axes (cartesian product) and draw
@@ -127,3 +133,172 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
                 _set_path(cfg, p, dom.sample(rng))
             variants.append(cfg)
     return variants
+
+
+# ---------------------------------------------------------------------------
+# Pluggable searchers (reference: `python/ray/tune/search/searcher.py`)
+
+
+class Searcher:
+    """Sequential suggestion interface: the controller calls ``suggest``
+    when it has capacity for a new trial and feeds results back through
+    ``on_trial_result`` / ``on_trial_complete`` (reference:
+    `tune/search/searcher.py` Searcher.suggest/on_trial_complete)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              param_space: Dict[str, Any]) -> None:
+        if metric is not None:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        self.param_space = param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Random/grid sampling as a Searcher (reference:
+    `tune/search/basic_variant.py`): grid axes are ENUMERATED round-robin
+    (every grid point runs before any repeats), Domain leaves resolve
+    randomly per suggestion."""
+
+    def __init__(self, metric=None, mode: str = "max",
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._rng = random.Random(seed)
+        self.param_space: Dict[str, Any] = {}
+        self._grid_combos: Optional[list] = None
+
+    def suggest(self, trial_id):
+        if self._grid_combos is None:
+            self._flat = dict(_walk(self.param_space))
+            grid = [(p, spec["grid_search"])
+                    for p, spec in self._flat.items() if _is_grid(spec)]
+            self._grid_paths = [p for p, _ in grid]
+            self._grid_combos = list(
+                itertools.product(*[vals for _, vals in grid])) or [()]
+            self._i = 0
+        combo = self._grid_combos[self._i % len(self._grid_combos)]
+        self._i += 1
+        config: Dict[str, Any] = {}
+        for path, spec in self._flat.items():
+            if _is_grid(spec):
+                continue
+            value = spec.sample(self._rng) if isinstance(spec, Domain) \
+                else spec
+            _set_path(config, path, value)
+        for path, v in zip(self._grid_paths, combo):
+            _set_path(config, path, v)
+        return config
+
+
+class TPESearcher(Searcher):
+    """Tree-structured-Parzen-Estimator-style bayesian search (the
+    method behind hyperopt/BOHB's model; reference integration point:
+    `tune/search/hyperopt/hyperopt_search.py`).
+
+    After ``n_initial_points`` random trials, completed trials split into
+    the top ``gamma`` quantile ("good") and the rest ("bad"); for each
+    Float/Integer dimension, candidates sampled from the domain are scored
+    by the kernel-density ratio l(x)/g(x) (Parzen windows over good vs bad
+    observations) and the best candidate wins.  Categorical dims use
+    smoothed category-frequency ratios.  Pure numpy, no extra deps.
+    """
+
+    def __init__(self, metric=None, mode: str = "max",
+                 n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self.param_space: Dict[str, Any] = {}
+        self._live: Dict[str, dict] = {}
+        self._history: List[tuple] = []  # (config, normalized score)
+
+    # ------------------------------------------------------------ feedback
+
+    def on_trial_complete(self, trial_id, result=None):
+        config = self._live.pop(trial_id, None)
+        if config is None or not result:
+            return
+        v = result.get(self.metric) if self.metric else None
+        if v is None:
+            return
+        self._history.append(
+            (config, float(v) if self.mode == "max" else -float(v)))
+
+    # ------------------------------------------------------------- suggest
+
+    def _kde_score(self, x: float, obs: List[float], span: float) -> float:
+        import math
+
+        if not obs:
+            return 1e-12
+        bw = max(span / max(len(obs) ** 0.5, 1.0), span * 0.05)
+        return sum(math.exp(-0.5 * ((x - o) / bw) ** 2)
+                   for o in obs) / (len(obs) * bw)
+
+    def _suggest_dim(self, path, domain, good: list, bad: list):
+        if isinstance(domain, Categorical):
+            cats = domain.categories
+            g_counts = {c: 1.0 for c in cats}  # +1 smoothing
+            b_counts = {c: 1.0 for c in cats}
+            for cfg, _ in good:
+                g_counts[_get_path(cfg, path)] = \
+                    g_counts.get(_get_path(cfg, path), 1.0) + 1
+            for cfg, _ in bad:
+                b_counts[_get_path(cfg, path)] = \
+                    b_counts.get(_get_path(cfg, path), 1.0) + 1
+            return max(cats, key=lambda c: g_counts[c] / b_counts[c])
+        if isinstance(domain, (Float, Integer)):
+            import math
+
+            log = getattr(domain, "log", False)
+            xform = (lambda v: math.log(v)) if log else (lambda v: v)
+            lo, hi = xform(domain.lower), xform(domain.upper)
+            span = hi - lo
+            g_obs = [xform(_get_path(cfg, path)) for cfg, _ in good]
+            b_obs = [xform(_get_path(cfg, path)) for cfg, _ in bad]
+            best, best_score = None, -1.0
+            for _ in range(self.n_candidates):
+                cand = domain.sample(self._rng)
+                x = xform(cand)
+                ratio = (self._kde_score(x, g_obs, span)
+                         / max(self._kde_score(x, b_obs, span), 1e-12))
+                if ratio > best_score:
+                    best, best_score = cand, ratio
+            return best
+        return domain.sample(self._rng)
+
+    def suggest(self, trial_id):
+        flat = dict(_walk(self.param_space))
+        config: Dict[str, Any] = {}
+        done = sorted(self._history, key=lambda cs: -cs[1])
+        use_model = len(done) >= self.n_initial
+        k = max(1, int(len(done) * self.gamma)) if use_model else 0
+        good, bad = done[:k], done[k:]
+        for path, spec in flat.items():
+            if _is_grid(spec):
+                value = self._rng.choice(spec["grid_search"])
+            elif isinstance(spec, Domain):
+                value = (self._suggest_dim(path, spec, good, bad)
+                         if use_model else spec.sample(self._rng))
+            else:
+                value = spec
+            _set_path(config, path, value)
+        self._live[trial_id] = config
+        return config

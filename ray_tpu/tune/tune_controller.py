@@ -77,11 +77,21 @@ class TuneController:
         self.tc = tune_config
         self.rc = run_config
         self.scheduler: TrialScheduler = tune_config.scheduler or FIFOScheduler()
+        self.searcher = getattr(tune_config, "search_alg", None)
+        self._search_budget = 0
         self.exp_dir = run_config.resolved_storage_path()
         os.makedirs(self.exp_dir, exist_ok=True)
         if param_space is None:
             # restore path: the caller installs a pre-built trial list
             self.trials: List[Trial] = []
+        elif self.searcher is not None:
+            # Pluggable searcher (reference: `tune/search/searcher.py`):
+            # trials are SUGGESTED lazily as capacity frees up, so later
+            # suggestions see earlier results (bayesian search).
+            self.searcher.set_search_properties(
+                tune_config.metric, tune_config.mode, param_space)
+            self.trials = []
+            self._search_budget = tune_config.num_samples
         else:
             configs = generate_variants(param_space,
                                         num_samples=tune_config.num_samples,
@@ -137,11 +147,34 @@ class TuneController:
 
     # ------------------------------------------------------------ event loop
 
+    def _maybe_suggest(self, n_active: int, max_conc: int):
+        """Ask the searcher for new trials while capacity and budget
+        remain."""
+        while (self.searcher is not None and self._search_budget > 0
+               and n_active < max_conc):
+            trial_id = f"trial_{len(self.trials):05d}"
+            config = self.searcher.suggest(trial_id)
+            if config is None:
+                return
+            trial = Trial(trial_id, config, self.exp_dir)
+            trial.ckpt_manager = CheckpointManager(
+                trial.dir, self.rc.checkpoint_config)
+            self.trials.append(trial)
+            self._search_budget -= 1
+            n_active += 1
+
     def run(self) -> List[Trial]:
-        max_conc = self.tc.max_concurrent_trials or len(self.trials)
+        # Searcher path: the budget (num_samples) bounds concurrency, same
+        # default as the pre-materialized path (all trials in parallel);
+        # sequential bayesian search is max_concurrent_trials=1.
+        default_conc = (self._search_budget if self.searcher is not None
+                        else len(self.trials))
+        max_conc = self.tc.max_concurrent_trials or max(default_conc, 1)
         start_time = time.monotonic()
         while True:
             running = [t for t in self.trials if t.state == RUNNING]
+            pending = [t for t in self.trials if t.state == PENDING]
+            self._maybe_suggest(len(running) + len(pending), max_conc)
             pending = [t for t in self.trials if t.state == PENDING]
             if not running and not pending:
                 break
@@ -186,10 +219,15 @@ class TuneController:
                 self._stop_trial(trial, PENDING)
                 return
             self._stop_trial(trial, ERRORED, error=str(payload))
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, None)
             return
         if kind == FINISHED:
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id,
+                                                trial.last_result)
             return
         metrics, ckpt_data = payload
         trial.iteration += 1
@@ -200,14 +238,20 @@ class TuneController:
             trial.latest_checkpoint_data = ckpt_data
             trial.ckpt_manager.register(
                 Checkpoint.from_dict(ckpt_data), metrics)
+        if self.searcher is not None:
+            self.searcher.on_trial_result(trial.trial_id, metrics)
         if self._met_stop_criteria(metrics):
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, metrics)
             return
         decision = self.scheduler.on_result(trial, metrics)
         if decision == STOP:
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(trial)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, metrics)
         elif decision == EXPLOIT:
             # PBT: restart from the donor's checkpoint with the perturbed
             # config (reference `pbt.py` _exploit; actor reuse via

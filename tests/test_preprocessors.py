@@ -1,0 +1,98 @@
+"""Data preprocessors: distributed fit, lazy transform, chains.
+
+Reference behaviors: `python/ray/data/preprocessors/` (StandardScaler,
+MinMaxScaler, LabelEncoder, OneHotEncoder, Concatenator, BatchMapper,
+Chain).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    StandardScaler,
+)
+
+
+@pytest.fixture(scope="module")
+def ray(ray_shared):
+    return ray_shared
+
+
+def _tab(ray):
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "a": [1.0, 2.0, 3.0, 4.0],
+        "b": [10.0, 20.0, 30.0, 40.0],
+        "label": ["cat", "dog", "cat", "bird"],
+    })
+    return data.from_pandas(df, parallelism=2)
+
+
+def test_standard_scaler(ray):
+    ds = _tab(ray)
+    sc = StandardScaler(columns=["a"]).fit(ds)
+    mean, std = sc.stats_["a"]
+    assert mean == 2.5 and np.isclose(std, np.std([1, 2, 3, 4]))
+    out = sc.transform(ds).take_all()
+    vals = np.array([r["a"] for r in out])
+    assert np.isclose(vals.mean(), 0.0) and np.isclose(vals.std(), 1.0)
+
+
+def test_min_max_scaler(ray):
+    ds = _tab(ray)
+    sc = MinMaxScaler(columns=["a", "b"]).fit(ds)
+    out = sc.transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert a.min() == 0.0 and a.max() == 1.0
+
+
+def test_label_and_onehot_encoders(ray):
+    ds = _tab(ray)
+    le = LabelEncoder(label_column="label").fit(ds)
+    assert le.stats_ == {"bird": 0, "cat": 1, "dog": 2}
+    out = le.transform(ds).take_all()
+    assert [r["label"] for r in out] == [1, 2, 1, 0]
+    back = le.inverse_transform_batch(
+        {"label": np.array([1, 2, 1, 0])})
+    assert back["label"].tolist() == ["cat", "dog", "cat", "bird"]
+
+    oh = OneHotEncoder(columns=["label"]).fit(ds)
+    batch = oh.transform_batch(
+        {"label": np.array(["cat", "bird"]), "a": np.array([1.0, 2.0])})
+    assert batch["label_cat"].tolist() == [1, 0]
+    assert batch["label_bird"].tolist() == [0, 1]
+    assert batch["label_dog"].tolist() == [0, 0]
+
+
+def test_concatenator_and_chain(ray):
+    ds = _tab(ray)
+    pre = Chain(
+        StandardScaler(columns=["a"]),
+        Concatenator(output_column_name="features", include=["a", "b"]),
+    ).fit(ds)
+    out = pre.transform(ds).take_all()
+    assert out[0]["features"].shape == (2,)
+    # serving-path single batch matches the dataset path
+    batch = pre.transform_batch(
+        {"a": np.array([1.0]), "b": np.array([10.0]),
+         "label": np.array(["cat"])})
+    np.testing.assert_allclose(batch["features"][0][0],
+                               (1.0 - 2.5) / np.std([1, 2, 3, 4]))
+
+
+def test_batch_mapper_and_unfitted_error(ray):
+    ds = _tab(ray)
+    bm = BatchMapper(lambda b: {**b, "a2": b["a"] * 2})
+    out = bm.transform(ds).take_all()
+    assert out[0]["a2"] == 2.0
+    with pytest.raises(RuntimeError):
+        StandardScaler(columns=["a"]).transform(ds)

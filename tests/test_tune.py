@@ -277,3 +277,73 @@ def test_class_trainable_iteration_survives_restart(ray, tmp_path):
     assert r.error is None, f"trial errored: {r.error}"
     assert r.metrics["training_iteration"] == 5
     assert r.metrics["score"] == 5
+
+
+def test_tpe_searcher_improves_over_random(ray, tmp_path):
+    """TPESearcher (reference: the hyperopt/BOHB model family in
+    `tune/search/`): later suggestions concentrate near the optimum of a
+    1-D quadratic once the model kicks in."""
+
+    def objective(config):
+        x = config["x"]
+        tune.report({"score": -(x - 3.0) ** 2})
+
+    searcher = tune.TPESearcher(n_initial_points=6, seed=0)
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=24, search_alg=searcher,
+                                    max_concurrent_trials=1),
+        run_config=tune.RunConfig(name="tpe", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 24
+    xs = [r.config["x"] for r in grid]
+    early = xs[:6]
+    late = xs[-8:]
+    err = lambda vals: sum(abs(v - 3.0) for v in vals) / len(vals)  # noqa: E731
+    assert err(late) < err(early), (early, late)
+    assert grid.get_best_result().metrics["score"] > -1.0
+
+
+def test_basic_variant_searcher(ray, tmp_path):
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=5,
+            search_alg=tune.BasicVariantGenerator(seed=1)),
+        run_config=tune.RunConfig(name="bv", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 5
+    assert all(0 <= r.config["x"] <= 1 for r in grid)
+
+
+def test_median_stopping_rule(ray, tmp_path):
+    """Bad trials stop early; good ones run to completion (reference:
+    `tune/schedulers/median_stopping_rule.py`)."""
+
+    def objective(config):
+        for i in range(12):
+            tune.report({"score": config["level"] + i * 0.01})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"level": tune.grid_search(
+            [10.0, 10.0, 10.0, 0.0, 0.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.MedianStoppingRule(
+                metric="score", grace_period=3, min_samples_required=2)),
+        run_config=tune.RunConfig(name="msr", storage_path=str(tmp_path)),
+    ).fit()
+    # the two level-0 trials stopped before the 10s finished
+    low = [r.metrics["training_iteration"] for r in grid
+           if r.config["level"] == 0.0]
+    high = [r.metrics["training_iteration"] for r in grid
+            if r.config["level"] == 10.0]
+    assert max(low) < 12
+    assert max(high) == 12

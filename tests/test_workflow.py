@@ -1,0 +1,118 @@
+"""DAG bind/execute + durable workflows with checkpoint/resume.
+
+Reference behaviors: `python/ray/dag/dag_node.py` (.bind graphs),
+`python/ray/workflow/` (run/resume/get_output/list_all with storage-backed
+step checkpoints).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def ray(ray_shared):
+    return ray_shared
+
+
+@pytest.fixture(autouse=True)
+def storage(tmp_path):
+    workflow.init_storage(str(tmp_path / "wf"))
+    yield
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def _mul(a, b):
+    return a * b
+
+
+@ray_tpu.remote
+def _record_and_double(x, touch_path=None):
+    if touch_path:
+        with open(touch_path, "a") as f:
+            f.write("ran\n")
+    return x * 2
+
+
+# ------------------------------------------------------------------- DAG
+
+
+def test_dag_bind_execute(ray):
+    dag = _add.bind(_mul.bind(2, 3), _mul.bind(4, 5))
+    assert ray_tpu.get(dag.execute()) == 26
+
+
+def test_dag_diamond_executes_shared_node_once(ray):
+    shared = _mul.bind(3, 3)
+    dag = _add.bind(shared, shared)
+    assert ray_tpu.get(dag.execute()) == 18
+
+
+def test_dag_input_node(ray):
+    x = InputNode()
+    dag = _add.bind(_mul.bind(x, 10), 1)
+    assert ray_tpu.get(dag.execute(4)) == 41
+
+
+# -------------------------------------------------------------- workflow
+
+
+def test_workflow_run_and_output(ray):
+    dag = _add.bind(_mul.bind(2, 3), 4)
+    assert workflow.run(dag, workflow_id="w1") == 10
+    assert workflow.get_status("w1") == "SUCCESSFUL"
+    assert workflow.get_output("w1") == 10
+    assert any(m["workflow_id"] == "w1" for m in workflow.list_all())
+
+
+def test_workflow_rerun_uses_checkpoints(ray, tmp_path):
+    touch = tmp_path / "touch.txt"
+    dag = _add.bind(_record_and_double.bind(5, str(touch)), 1)
+    assert workflow.run(dag, workflow_id="w2") == 11
+    runs_before = touch.read_text().count("ran")
+    # a completed workflow returns its stored output without re-executing
+    assert workflow.run(dag, workflow_id="w2") == 11
+    assert touch.read_text().count("ran") == runs_before
+
+
+def test_workflow_resume_after_failure(ray, tmp_path):
+    """A failing step fails the workflow; after the cause is fixed,
+    resume() skips the already-checkpointed steps and completes."""
+    flag = tmp_path / "ok"
+    touch = tmp_path / "touch2.txt"
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky(x, flag_path):
+        import os
+
+        if not os.path.exists(flag_path):
+            raise RuntimeError("flaky failure")
+        return x + 100
+
+    dag = flaky.bind(_record_and_double.bind(7, str(touch)), str(flag))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w3")
+    assert workflow.get_status("w3") == "FAILED"
+    first_runs = touch.read_text().count("ran")
+    assert first_runs >= 1  # the upstream step committed before the crash
+
+    flag.write_text("go")
+    assert workflow.resume("w3") == 114
+    assert workflow.get_status("w3") == "SUCCESSFUL"
+    # upstream step was NOT re-executed on resume
+    assert touch.read_text().count("ran") == first_runs
+
+
+def test_workflow_delete(ray):
+    dag = _add.bind(1, 2)
+    workflow.run(dag, workflow_id="w4")
+    workflow.delete("w4")
+    with pytest.raises(ValueError):
+        workflow.get_status("w4")
