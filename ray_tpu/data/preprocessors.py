@@ -65,7 +65,7 @@ class Preprocessor:
                 f"{type(self).__name__} must be fit() before transform")
 
 
-def _column_partials(ds, columns: List[str], partial_fn: Callable):
+def _column_partials(ds, partial_fn: Callable):
     """Run ``partial_fn(block) -> partial`` over every block as tasks and
     return the partials (driver-side combine stays tiny)."""
     import ray_tpu
@@ -96,7 +96,7 @@ class StandardScaler(Preprocessor):
                         float(np.sum(np.square(block[c], dtype=np.float64))))
                     for c in cols}
 
-        partials = _column_partials(ds, cols, partial)
+        partials = _column_partials(ds, partial)
         for c in cols:
             n = sum(p[c][0] for p in partials)
             s = sum(p[c][1] for p in partials)
@@ -128,7 +128,7 @@ class MinMaxScaler(Preprocessor):
             return {c: (float(np.min(block[c])), float(np.max(block[c])))
                     for c in cols}
 
-        partials = _column_partials(ds, cols, partial)
+        partials = _column_partials(ds, partial)
         for c in cols:
             lo = min(p[c][0] for p in partials)
             hi = max(p[c][1] for p in partials)
@@ -156,15 +156,20 @@ class LabelEncoder(Preprocessor):
         def partial(block):
             return np.unique(np.asarray(block[col]))
 
-        partials = _column_partials(ds, [col], partial)
+        partials = _column_partials(ds, partial)
         values = sorted(set().union(*[set(p.tolist()) for p in partials]))
         self.stats_ = {v: i for i, v in enumerate(values)}
 
     def _transform_batch(self, batch):
         mapping = self.stats_
+        values = np.asarray(batch[self.label_column]).tolist()
+        unseen = sorted({v for v in values if v not in mapping})
+        if unseen:
+            raise ValueError(
+                f"LabelEncoder({self.label_column!r}): values {unseen!r} "
+                "were not present at fit time")
         batch[self.label_column] = np.asarray(
-            [mapping[v] for v in np.asarray(
-                batch[self.label_column]).tolist()], np.int64)
+            [mapping[v] for v in values], np.int64)
         return batch
 
     def inverse_transform_batch(self, batch):
@@ -190,7 +195,7 @@ class OneHotEncoder(Preprocessor):
         def partial(block):
             return {c: np.unique(np.asarray(block[c])) for c in cols}
 
-        partials = _column_partials(ds, cols, partial)
+        partials = _column_partials(ds, partial)
         for c in cols:
             self.stats_[c] = sorted(
                 set().union(*[set(p[c].tolist()) for p in partials]))

@@ -215,7 +215,6 @@ class DeploymentHandle:
         replica = self._pick_replica()
         with _model_affinity_lock:
             _model_affinity[key] = replica
-            _model_affinity.move_to_end(key)
             while len(_model_affinity) > _MODEL_AFFINITY_CAP:
                 _model_affinity.popitem(last=False)
         return replica

@@ -107,6 +107,16 @@ def _get_path(d: dict, path):
     return d
 
 
+def grid_size(param_space: Dict[str, Any]) -> int:
+    """Number of grid points (product of grid_search axis lengths; 1 when
+    no grids)."""
+    n = 1
+    for _, spec in _walk(param_space):
+        if _is_grid(spec):
+            n *= max(len(spec["grid_search"]), 1)
+    return n
+
+
 def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
                       seed: Optional[int] = None) -> List[Dict[str, Any]]:
     """Expand grid_search axes (cartesian product) and draw
@@ -145,15 +155,20 @@ class Searcher:
     ``on_trial_result`` / ``on_trial_complete`` (reference:
     `tune/search/searcher.py` Searcher.suggest/on_trial_complete)."""
 
-    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
         self.metric = metric
-        self.mode = mode
+        # Remember what the USER set: TuneConfig's defaults must not
+        # clobber an explicit constructor choice (mode='min' searchers
+        # would silently maximize otherwise).
+        self._mode_user_set = mode is not None
+        self.mode = mode or "max"
 
     def set_search_properties(self, metric: Optional[str], mode: str,
                               param_space: Dict[str, Any]) -> None:
-        if metric is not None:
+        if metric is not None and self.metric is None:
             self.metric = metric
-        if mode:
+        if mode and not self._mode_user_set:
             self.mode = mode
         self.param_space = param_space
 
@@ -174,7 +189,7 @@ class BasicVariantGenerator(Searcher):
     (every grid point runs before any repeats), Domain leaves resolve
     randomly per suggestion."""
 
-    def __init__(self, metric=None, mode: str = "max",
+    def __init__(self, metric=None, mode: Optional[str] = None,
                  seed: Optional[int] = None):
         super().__init__(metric, mode)
         self._rng = random.Random(seed)
@@ -217,7 +232,7 @@ class TPESearcher(Searcher):
     smoothed category-frequency ratios.  Pure numpy, no extra deps.
     """
 
-    def __init__(self, metric=None, mode: str = "max",
+    def __init__(self, metric=None, mode: Optional[str] = None,
                  n_initial_points: int = 8, gamma: float = 0.25,
                  n_candidates: int = 24, seed: Optional[int] = None):
         super().__init__(metric, mode)

@@ -91,7 +91,12 @@ class TuneController:
             self.searcher.set_search_properties(
                 tune_config.metric, tune_config.mode, param_space)
             self.trials = []
-            self._search_budget = tune_config.num_samples
+            # Match the pre-materialized path's semantics: grids expand to
+            # grid_size x num_samples trials, so every grid point runs.
+            from ray_tpu.tune.search import grid_size
+
+            self._search_budget = (tune_config.num_samples
+                                   * grid_size(param_space))
         else:
             configs = generate_variants(param_space,
                                         num_samples=tune_config.num_samples,

@@ -1,0 +1,70 @@
+"""Chaos / fault-injection helpers for tests.
+
+Reference analogue: `python/ray/_private/test_utils.py:1400`
+(NodeKillerActor / ResourceKillerActor, ``kill_raylet :1741``) and
+`python/ray/tests/test_chaos.py`.  Works against the fake in-machine
+cluster (`ray_tpu/cluster_utils.py`): periodically SIGKILLs a random
+worker NODE (never the head) while a workload runs, so retries, actor
+failover, and lineage reconstruction are exercised under real process
+death.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["NodeKiller"]
+
+
+class NodeKiller:
+    """Background thread killing random worker nodes of a Cluster at an
+    interval; optionally respawns a replacement so capacity survives."""
+
+    def __init__(self, cluster, kill_interval_s: float = 1.0,
+                 respawn: bool = True, seed: Optional[int] = None,
+                 max_kills: int = 1_000_000):
+        self.cluster = cluster
+        self.kill_interval_s = kill_interval_s
+        self.respawn = respawn
+        self.max_kills = max_kills
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="node-killer",
+                                        daemon=True)
+
+    def start(self) -> "NodeKiller":
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.kill_interval_s):
+            if len(self.killed) >= self.max_kills:
+                return
+            head = getattr(self.cluster, "head_node", None)
+            victims = [n for n in self.cluster.nodes
+                       if n is not head and n.alive()]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            resources = dict(node.resources)
+            store_mb = 64
+            self.cluster.remove_node(node)  # SIGKILL
+            self.killed.append(node.node_id)
+            if self.respawn:
+                cpus = resources.pop("CPU", 1)
+                tpus = resources.pop("TPU", 0)
+                try:
+                    self.cluster.add_node(
+                        num_cpus=cpus, num_tpus=tpus,
+                        resources=resources or None,
+                        object_store_mb=store_mb)
+                except Exception:  # noqa: BLE001 — cluster shutting down
+                    return
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)

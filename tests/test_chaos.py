@@ -1,0 +1,119 @@
+"""Chaos / fault injection: node kills mid-workload, OOM worker killing.
+
+Reference behaviors: `python/ray/tests/test_chaos.py` (NodeKillerActor
+workloads survive node churn), MemoryMonitor + retriable-FIFO worker
+killing (`src/ray/common/memory_monitor.h:52`,
+`worker_killing_policy_retriable_fifo.cc`).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.chaos import NodeKiller
+
+
+def test_tasks_survive_node_churn():
+    """Retriable tasks all complete while worker nodes are being
+    SIGKILLed and replaced under them."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1})
+    try:
+        for _ in range(2):
+            c.add_node(num_cpus=2)
+        c.wait_for_nodes(3)
+        c.connect()
+
+        @ray_tpu.remote(num_cpus=1, max_retries=8)
+        def work(i):
+            time.sleep(0.3)
+            return i * i
+
+        killer = NodeKiller(c, kill_interval_s=0.8, respawn=True,
+                            seed=7, max_kills=3).start()
+        try:
+            refs = [work.remote(i) for i in range(24)]
+            out = ray_tpu.get(refs, timeout=180)
+        finally:
+            killer.stop()
+        assert sorted(out) == sorted(i * i for i in range(24))
+        assert killer.killed, "chaos never fired"
+    finally:
+        c.shutdown()
+
+
+def test_named_actor_survives_node_kill():
+    """A restartable named actor fails over when its node is killed
+    mid-call-stream (reference: chaos + actor FT suites)."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=1, resources={"slot": 1})
+        c.add_node(num_cpus=1, resources={"slot": 1})
+        c.wait_for_nodes(3)
+        c.connect()
+
+        @ray_tpu.remote(max_restarts=4, resources={"slot": 0.5})
+        class Svc:
+            def ping(self):
+                import os
+
+                return os.getpid()
+
+        svc = Svc.options(name="chaos_svc").remote()
+        pid1 = ray_tpu.get(svc.ping.remote(), timeout=30)
+        # find and kill the node hosting the actor (not the head)
+        victim = None
+        for node in c.nodes[1:]:
+            if node.alive():
+                victim = node
+                break
+        c.remove_node(victim)
+        deadline = time.time() + 60
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                pid2 = ray_tpu.get(svc.ping.remote(), timeout=10)
+                break
+            except ray_tpu.ActorDiedError:
+                time.sleep(0.5)
+        assert pid2 is not None
+    finally:
+        c.shutdown()
+
+
+def test_oom_killer_retriable_fifo(tmp_path):
+    """With the memory monitor reading a test-seam usage file, crossing
+    the threshold kills the most-recently-started retriable worker; the
+    task retries and completes once pressure clears."""
+    usage = tmp_path / "usage"
+    usage.write_text("0.1")
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 2},
+                env={"RAY_TPU_MEMORY_MONITOR_INTERVAL_S": "0.1",
+                     "RAY_TPU_MEMORY_USAGE_THRESHOLD": "0.9",
+                     "RAY_TPU_MEMORY_USAGE_FILE": str(usage)})
+    try:
+        c.wait_for_nodes(1)
+        c.connect()
+        marker = tmp_path / "attempts"
+
+        @ray_tpu.remote(num_cpus=1, max_retries=4)
+        def hog(path):
+            with open(path, "a") as f:
+                f.write("x")
+            time.sleep(3.0)
+            return "done"
+
+        ref = hog.remote(str(marker))
+        # let the task start, then simulate memory pressure
+        deadline = time.time() + 30
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.05)
+        assert marker.exists()
+        usage.write_text("0.99")
+        time.sleep(0.6)   # monitor fires, kills the worker
+        usage.write_text("0.1")  # pressure clears; retry succeeds
+        assert ray_tpu.get(ref, timeout=60) == "done"
+        assert marker.read_text().count("x") >= 2  # it really was killed
+    finally:
+        c.shutdown()
