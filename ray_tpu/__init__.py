@@ -88,6 +88,13 @@ def init(
     if address is not None:
         from ray_tpu.core.client import ClientWorker
 
+        # "ray://host:port" (reference Ray Client URI scheme,
+        # `python/ray/client_builder.py:90`) and bare "host:port" both
+        # attach this process as a remote driver — the client-mode
+        # ClientWorker IS the remote-driver proxy here (same TCP path for
+        # local and remote drivers; no separate proxy server needed).
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
         init_worker(ClientWorker(address, log_to_driver=log_to_driver))
         return
     init_worker(

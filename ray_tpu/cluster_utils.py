@@ -71,11 +71,15 @@ def make_cluster_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     return env
 
 
-def spawn_gcs(env: Dict[str, str]):
+def spawn_gcs(env: Dict[str, str], port: int = 0,
+              persist: Optional[str] = None):
     """Start a GCS server process; returns ``(proc, address)``."""
+    cmd = [sys.executable, "-m", "ray_tpu.core.gcs_main", "--port",
+           str(port)]
+    if persist:
+        cmd += ["--persist", persist]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.core.gcs_main"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         text=True, env=env)
     banner = _read_tagged_line(proc, "GCS_ADDRESS")
     return proc, banner.split()[1]
@@ -106,10 +110,17 @@ class Cluster:
 
     def __init__(self, initialize_head: bool = True,
                  head_resources: Optional[Dict[str, float]] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 gcs_persist_path: Optional[str] = None):
+        """``gcs_persist_path``: enable GCS fault tolerance — durable
+        tables snapshot there and ``restart_gcs()`` brings the control
+        plane back on the SAME port (raylets need
+        RAY_TPU_GCS_RECONNECT_TIMEOUT_S > 0 to ride through)."""
         self._env = make_cluster_env(env)
+        self._gcs_persist = gcs_persist_path
         self.nodes: List[NodeHandle] = []
-        self._gcs_proc, self.address = spawn_gcs(self._env)
+        self._gcs_proc, self.address = spawn_gcs(
+            self._env, persist=gcs_persist_path)
         self._connected = False
         if initialize_head:
             self.head_node = self.add_node(
@@ -125,6 +136,32 @@ class Cluster:
         handle = spawn_raylet(self.address, res, object_store_mb, self._env)
         self.nodes.append(handle)
         return handle
+
+    def kill_gcs(self):
+        """SIGKILL the GCS process (chaos; reference:
+        `test_gcs_fault_tolerance.py`)."""
+        if self._gcs_proc.poll() is None:
+            self._gcs_proc.send_signal(signal.SIGKILL)
+            self._gcs_proc.wait(timeout=10)
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME address from its persisted
+        snapshot.  Requires gcs_persist_path."""
+        assert self._gcs_persist, "Cluster(gcs_persist_path=...) required"
+        self.kill_gcs()
+        port = int(self.address.rsplit(":", 1)[1])
+        deadline = time.monotonic() + 15
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                self._gcs_proc, addr = spawn_gcs(
+                    self._env, port=port, persist=self._gcs_persist)
+                assert addr == self.address
+                return
+            except RuntimeError as e:  # port still in TIME_WAIT
+                last_err = e
+                time.sleep(0.3)
+        raise RuntimeError(f"could not restart GCS: {last_err}")
 
     def remove_node(self, node: NodeHandle, allow_graceful: bool = False):
         """SIGKILL by default — simulates node failure (reference:

@@ -77,7 +77,10 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method_name,
         )
-        refs = worker.submit_spec(spec)
+        from ray_tpu.util.tracing import submit_with_span
+
+        refs = submit_with_span(worker, spec,
+                                actor_id=self._actor_id.hex())
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 

@@ -29,6 +29,7 @@ class ClientWorker(Worker):
                  log_to_driver: bool = True):
         super().__init__("client")
         self.log_to_driver = log_to_driver
+        self._gcs_address = gcs_address
         self.gcs = GcsClient(gcs_address)
         nodes = [n for n in self.gcs.nodes() if n["alive"] and n["address"]]
         if not nodes:
@@ -123,23 +124,37 @@ class ClientWorker(Worker):
             raise msg["error"]
         return msg["value"]
 
+    def _gcs_call(self, op, *args):
+        """GCS ops with one reconnect retry — after a GCS restart (fault
+        tolerance) the old socket is dead but the service is back."""
+        try:
+            return getattr(self.gcs, op)(*args)
+        except (ConnectionError, TimeoutError, OSError):
+            new = GcsClient(self._gcs_address)
+            old, self.gcs = self.gcs, new
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+            return getattr(self.gcs, op)(*args)
+
     def gcs_nodes(self):
-        return self.gcs.nodes()
+        return self._gcs_call("nodes")
 
     def kv_put(self, key: bytes, value: bytes, namespace: str = ""):
-        self.gcs.kv_put(namespace, key, value)
+        self._gcs_call("kv_put", namespace, key, value)
 
     def kv_get(self, key: bytes, namespace: str = ""):
-        return self.gcs.kv_get(namespace, key)
+        return self._gcs_call("kv_get", namespace, key)
 
     def kv_del(self, key: bytes, namespace: str = ""):
-        return self.gcs.kv_del(namespace, key)
+        return self._gcs_call("kv_del", namespace, key)
 
     def kv_keys(self, prefix: bytes, namespace: str = ""):
-        return self.gcs.kv_keys(namespace, prefix)
+        return self._gcs_call("kv_keys", namespace, prefix)
 
     def _push_function(self, fid, blob: bytes):
-        self.gcs.put_function(fid.binary(), blob)
+        self._gcs_call("put_function", fid.binary(), blob)
 
     def shutdown(self):
         try:

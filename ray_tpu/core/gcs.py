@@ -27,6 +27,7 @@ and the object manager (`src/ray/object_manager/object_manager.h:117`).
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -44,10 +45,24 @@ config.define("gcs_node_timeout_s", float, 3.0,
 
 
 class GcsCore:
-    """All control-plane tables. Thread-safe; no I/O of its own."""
+    """All control-plane tables. Thread-safe; no I/O of its own beyond the
+    optional persistence snapshots.
 
-    def __init__(self):
+    Persistence (reference: the GCS store clients —
+    `src/ray/gcs/store_client/redis_store_client.h:33` for fault
+    tolerance, `in_memory_store_client.h:31` otherwise): with
+    ``persist_path`` set, the DURABLE tables (kv, functions, actors,
+    named actors, placement groups) snapshot to disk on mutation
+    (dirty-flag + background flusher, atomic rename) and reload on
+    construction.  Node membership and the object directory are SOFT
+    state: raylets re-register and re-publish object locations when they
+    reconnect after a GCS restart (the reference's raylet↔GCS reconnect
+    protocol, `test_gcs_fault_tolerance.py`)."""
+
+    def __init__(self, persist_path: Optional[str] = None):
         self._lock = threading.RLock()
+        self._persist_path = persist_path
+        self._dirty = False
         # node_id(hex) -> {address:(host,port)|None, resources_total,
         #                  resources_available, store_path, alive,
         #                  last_heartbeat, hostname}
@@ -68,6 +83,71 @@ class GcsCore:
         self._subs: List[Tuple[Optional[str], Callable[[str, Any], None]]] = []
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        if persist_path:
+            self._load_snapshot()
+            self._start_flusher()
+
+    # ------------------------------------------------------- persistence
+
+    def _mark_dirty(self):
+        if self._persist_path:
+            self._dirty = True
+
+    def _load_snapshot(self):
+        import pickle
+
+        try:
+            with open(self._persist_path, "rb") as f:
+                snap = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return
+        with self._lock:
+            self._kv = snap.get("kv", {})
+            self._functions = snap.get("functions", {})
+            self._actors = snap.get("actors", {})
+            self._named = snap.get("named", {})
+            self._cluster_pgs = snap.get("cluster_pgs", {})
+            # Actors whose host nodes are gone (nodes are soft state) are
+            # surfaced as restarting; their home raylet reconciles on
+            # reconnect.
+            for info in self._actors.values():
+                if info.get("state") == "alive":
+                    info["state"] = "restarting"
+
+    def _write_snapshot(self):
+        import pickle
+
+        with self._lock:
+            snap = pickle.dumps({
+                "kv": dict(self._kv),
+                "functions": dict(self._functions),
+                "actors": {k: dict(v) for k, v in self._actors.items()},
+                "named": dict(self._named),
+                "cluster_pgs": {k: {**v, "pending": set(v["pending"])}
+                                for k, v in self._cluster_pgs.items()},
+            }, protocol=5)
+            self._dirty = False
+        tmp = self._persist_path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(snap)
+        os.replace(tmp, self._persist_path)
+
+    def _start_flusher(self):
+        def loop():
+            while not self._stop.wait(0.1):
+                if self._dirty:
+                    try:
+                        self._write_snapshot()
+                    except OSError:
+                        pass
+            if self._dirty:  # final flush on shutdown
+                try:
+                    self._write_snapshot()
+                except OSError:
+                    pass
+
+        threading.Thread(target=loop, name="gcs-persist",
+                         daemon=True).start()
 
     # ----------------------------------------------------------- pubsub
 
@@ -211,6 +291,7 @@ class GcsCore:
                 for i in affected:
                     del entry["assignments"][i]
                 entry["state"] = "reserving"
+                self._mark_dirty()
             sub_bundles = [entry["bundles"][i] for i in affected]
             placed = self._place_bundles(sub_bundles, entry["strategy"])
             if placed is None:
@@ -219,6 +300,7 @@ class GcsCore:
                 for j, node in placed.items():
                     entry["assignments"][affected[j]] = node
                     entry["pending"].add(node)
+                self._mark_dirty()
             for node in set(placed.values()):
                 sub = {affected[j]: sub_bundles[j]
                        for j, n in placed.items() if n == node}
@@ -250,6 +332,13 @@ class GcsCore:
 
     def stop(self):
         self._stop.set()
+        # Synchronous final flush: a graceful shutdown must not lose
+        # acknowledged durable mutations to the async-flusher window.
+        if self._persist_path and self._dirty:
+            try:
+                self._write_snapshot()
+            except OSError:
+                pass
 
     # ----------------------------------------------------------- placement
 
@@ -305,6 +394,7 @@ class GcsCore:
                 "pending": set(assignments.values()),
                 "state": "reserving",
             }
+        self._mark_dirty()
         for node in set(assignments.values()):
             sub = {i: bundles[i] for i, n in assignments.items()
                    if n == node}
@@ -402,12 +492,15 @@ class GcsCore:
             if done:
                 entry["state"] = "created"
             origin = entry["origin"]
+            self._mark_dirty()
         if done:
             self._publish("pg_ready", {"pg_id": pg_id}, target_node=origin)
 
     def remove_cluster_pg(self, pg_id: str):
         with self._lock:
             entry = self._cluster_pgs.pop(pg_id, None)
+            if entry is not None:
+                self._mark_dirty()
         if entry is None:
             return False
         for node in set(entry["assignments"].values()):
@@ -431,6 +524,7 @@ class GcsCore:
     def kv_put(self, ns: str, key: bytes, val: bytes):
         with self._lock:
             self._kv[(ns, key)] = val
+            self._mark_dirty()
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -438,7 +532,10 @@ class GcsCore:
 
     def kv_del(self, ns: str, key: bytes) -> bool:
         with self._lock:
-            return self._kv.pop((ns, key), None) is not None
+            existed = self._kv.pop((ns, key), None) is not None
+            if existed:
+                self._mark_dirty()
+            return existed
 
     def kv_keys(self, ns: str, prefix: bytes) -> List[bytes]:
         with self._lock:
@@ -450,6 +547,7 @@ class GcsCore:
     def put_function(self, fid: bytes, blob: bytes):
         with self._lock:
             self._functions[fid] = blob
+            self._mark_dirty()
 
     def get_function(self, fid: bytes) -> Optional[bytes]:
         with self._lock:
@@ -475,6 +573,7 @@ class GcsCore:
             }
             if name:
                 self._named[(namespace, name)] = actor_id
+            self._mark_dirty()
             return True
 
     def update_actor(self, actor_id: bytes, state: str,
@@ -486,6 +585,7 @@ class GcsCore:
             info["state"] = state
             if node_id is not None:
                 info["exec_node"] = node_id
+            self._mark_dirty()
 
     def remove_actor(self, actor_id: bytes):
         with self._lock:
@@ -494,6 +594,8 @@ class GcsCore:
                 key = (info["namespace"], info["name"])
                 if self._named.get(key) == actor_id:
                     del self._named[key]
+            if info is not None:
+                self._mark_dirty()
 
     def get_actor(self, actor_id: bytes) -> Optional[dict]:
         with self._lock:
@@ -597,8 +699,9 @@ class GcsServer:
     """TCP front-end for a GcsCore; one reader thread per connection."""
 
     def __init__(self, core: Optional[GcsCore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.core = core or GcsCore()
+                 host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
+        self.core = core or GcsCore(persist_path=persist_path)
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self._conns: List[socket.socket] = []

@@ -17,11 +17,15 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--persist", default=None,
+                        help="snapshot file for GCS fault tolerance "
+                        "(reference: Redis-backed GCS persistence)")
     args = parser.parse_args()
 
     from ray_tpu.core.gcs import GcsServer
 
-    server = GcsServer(host=args.host, port=args.port)
+    server = GcsServer(host=args.host, port=args.port,
+                       persist_path=args.persist)
     print(f"GCS_ADDRESS {server.address}", flush=True)
 
     stop = threading.Event()

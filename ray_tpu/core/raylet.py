@@ -56,6 +56,12 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 
+config.define("gcs_reconnect_timeout_s", float, 0.0,
+              "GCS fault tolerance: on a lost GCS connection, retry "
+              "reconnecting for this long before shutting the node down "
+              "(reference: raylet<->GCS reconnect in "
+              "`test_gcs_fault_tolerance.py`).  0 = shut down immediately "
+              "(the default; process trees reap cleanly in tests).")
 config.define("memory_monitor_interval_s", float, 0.0,
               "OOM prevention (reference: `memory_monitor.h:52`): poll "
               "host memory every interval and kill a worker above the "
@@ -960,10 +966,15 @@ class Raylet:
         self.call_async(self._on_gcs_event, event, data)
 
     def _on_gcs_lost(self):
-        """GCS connection dropped (reader thread): the node is partitioned
-        from the control plane — shut down rather than orphan the worker
-        tree."""
+        """GCS connection dropped (reader thread): with reconnect enabled
+        (GCS fault tolerance — the GCS restarts with persisted tables),
+        retry dialing it; otherwise the node is partitioned from the
+        control plane — shut down rather than orphan the worker tree."""
         if self._shutdown:
+            return
+        if config.gcs_reconnect_timeout_s > 0 and self.gcs_address:
+            threading.Thread(target=self._gcs_reconnect_loop,
+                             name="gcs-reconnect", daemon=True).start()
             return
         sys.stderr.write(
             f"[ray_tpu] node {self.node_id[:8]}: GCS connection lost — "
@@ -975,6 +986,62 @@ class Raylet:
             pass
         if self.on_fatal is not None:
             self._safe(self.on_fatal)
+
+    def _gcs_reconnect_loop(self):
+        """Reader-thread side: dial the (restarted) GCS until the timeout,
+        then hand over to the event loop to re-register and re-publish
+        this node's object locations."""
+        deadline = time.monotonic() + config.gcs_reconnect_timeout_s
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: GCS connection lost — "
+            f"reconnecting for up to {config.gcs_reconnect_timeout_s:.0f}s\n")
+        while time.monotonic() < deadline and not self._shutdown:
+            try:
+                new_gcs = GcsClient(self.gcs_address,
+                                    push_handler=self._gcs_push,
+                                    on_disconnect=self._on_gcs_lost)
+                break
+            except (ConnectionError, OSError):
+                time.sleep(0.25)
+        else:
+            if not self._shutdown:
+                config.gcs_reconnect_timeout_s = 0.0  # no second chance
+                self._on_gcs_lost()
+            return
+        self.call_async(self._after_gcs_reconnect, new_gcs)
+
+    def _after_gcs_reconnect(self, new_gcs):
+        """Event loop: swap the client in, re-register (node table is soft
+        state), resubscribe, and re-publish this node's sealed objects to
+        the rebuilt object directory.  A connection dropping again
+        mid-handshake just re-enters the reconnect loop."""
+        old, self.gcs = self.gcs, new_gcs
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.gcs.subscribe_remote(node_id=self.node_id)
+        except (ConnectionError, TimeoutError, OSError):
+            self._on_gcs_lost()
+            return
+        self._gcs_safe(self.gcs.register_node,
+                       self.node_id, (self.node_ip, self.tcp_port),
+                       self.resources_total, store_path=self.store_path,
+                       hostname=socket.gethostname())
+        for oid, st in self._objects.items():
+            if st.status == "store":
+                self._gcs_safe(self.gcs.add_object_location,
+                               oid.hex(), self.node_id, size=st.size or 0)
+        # Reconcile actor state: the restarted GCS loaded persisted actors
+        # as "restarting" (it cannot know which survived); every actor
+        # LIVE on this node re-asserts itself.
+        for aid, actor in self._actors.items():
+            if actor.state == "alive" and actor.conn is not None:
+                self._gcs_safe(self.gcs.update_actor, aid.binary(), "alive",
+                               node_id=self.node_id)
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: reconnected to GCS\n")
 
     def _on_gcs_event(self, event: str, data):
         if event == "node_added":

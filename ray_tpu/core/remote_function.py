@@ -92,7 +92,9 @@ class RemoteFunction:
             runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=_placement_from_opts(opts),
         )
-        refs = worker.submit_spec(spec)
+        from ray_tpu.util.tracing import submit_with_span
+
+        refs = submit_with_span(worker, spec)
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator
 

@@ -52,6 +52,10 @@ class TaskSpec:
     placement: Optional[dict] = None
     # Owner bookkeeping
     submitter: str = "driver"
+    # Tracing: submit-span context {trace_id, span_id} propagated to the
+    # executing worker (reference: span context in task metadata,
+    # `tracing_helper.py:289`)
+    trace_ctx: Optional[dict] = None
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == STREAMING_RETURNS:

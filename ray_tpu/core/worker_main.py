@@ -209,6 +209,20 @@ def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):
 
 
 async def _execute_async(worker: RemoteWorker, msg: dict):
+    from ray_tpu.util import tracing
+
+    spec: TaskSpec = msg["spec"]
+    if tracing.tracing_enabled():
+        with tracing.span(f"task.run {spec.name}", parent=spec.trace_ctx,
+                          task_id=spec.task_id.hex(), kind=spec.kind) as sp:
+            ok = await _execute_async_inner(worker, msg)
+            if not ok:
+                sp.set_error("task raised (see error object)")
+        return
+    await _execute_async_inner(worker, msg)
+
+
+async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
     spec: TaskSpec = msg["spec"]
     try:
         args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
@@ -218,6 +232,7 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
         inline, stored, sizes = _package_results(worker, spec, result)
         worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
                       "inline": inline, "stored": stored, "sizes": sizes})
+        return True
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
@@ -225,9 +240,29 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
+        return False
 
 
 def execute_task(worker: RemoteWorker, msg: dict):
+    spec: TaskSpec = msg["spec"]
+    from ray_tpu.util import tracing
+
+    if tracing.tracing_enabled():
+        # child span of the submit-side span (reference:
+        # `_inject_tracing_into_function`, `tracing_helper.py:322`)
+        with tracing.span(f"task.run {spec.name}", parent=spec.trace_ctx,
+                          task_id=spec.task_id.hex(),
+                          kind=spec.kind) as sp:
+            ok = _execute_task_inner(worker, msg)
+            if not ok:
+                # user exception already converted to an error reply —
+                # reflect it on the span (the with-block sees no raise)
+                sp.set_error("task raised (see error object)")
+            return ok
+    return _execute_task_inner(worker, msg)
+
+
+def _execute_task_inner(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
     try:
         _apply_runtime_env(spec)
@@ -264,6 +299,7 @@ def execute_task(worker: RemoteWorker, msg: dict):
         inline, stored, sizes = _package_results(worker, spec, result)
         worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
                       "inline": inline, "stored": stored, "sizes": sizes})
+        return True
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
@@ -271,6 +307,7 @@ def execute_task(worker: RemoteWorker, msg: dict):
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
+        return False
 
 
 class _PrefixStream:
@@ -314,6 +351,10 @@ def main():
         prefix = f"(worker pid={os.getpid()}) "
         sys.stdout = _PrefixStream(sys.stdout, prefix)
         sys.stderr = _PrefixStream(sys.stderr, prefix)
+
+    from ray_tpu.util import tracing
+
+    tracing.maybe_enable_from_env()
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(args.socket)

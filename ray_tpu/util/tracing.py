@@ -1,0 +1,160 @@
+"""Distributed tracing: spans around task/actor submission + execution.
+
+Reference analogue: `python/ray/util/tracing/tracing_helper.py`
+(``_tracing_task_invocation :289`` wraps submission,
+``_inject_tracing_into_function :322`` wraps execution, span context rides
+in task metadata).  Same shape here, first-class instead of monkey-wrapped:
+when tracing is enabled, ``remote()`` records a submit span and stamps a
+W3C-style context (trace_id, span_id) onto the TaskSpec; the executing
+worker opens a child span around the user function.
+
+Exporter: spans append to ``$RAY_TPU_TRACE_DIR/<pid>.jsonl`` (one process,
+one file — chrome://tracing and OpenTelemetry collectors both ingest
+line-JSON easily).  The opentelemetry *API* package is optional and not
+required; span ids use the same 128/64-bit hex format so exported spans
+correlate with any surrounding otel spans.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["enable_tracing", "tracing_enabled", "span", "current_trace_ctx"]
+
+_ENV = "RAY_TPU_TRACE_DIR"
+
+_enabled = False
+_trace_dir: Optional[str] = None
+_file = None
+_file_lock = threading.Lock()
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)  # {"trace_id", "span_id"}
+
+
+def enable_tracing(trace_dir: Optional[str] = None) -> str:
+    """Turn tracing on for this process AND future workers (the directory
+    is exported via the environment, which spawned workers inherit —
+    reference: tracing startup hook).  Returns the trace dir."""
+    global _enabled, _trace_dir
+    trace_dir = trace_dir or os.environ.get(_ENV) \
+        or os.path.join(os.path.expanduser("~"), ".ray_tpu", "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    os.environ[_ENV] = trace_dir
+    _trace_dir = trace_dir
+    _enabled = True
+    return trace_dir
+
+
+def maybe_enable_from_env():
+    """Called at worker startup: inherit the driver's tracing choice."""
+    if os.environ.get(_ENV):
+        enable_tracing(os.environ[_ENV])
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def current_trace_ctx() -> Optional[Dict[str, str]]:
+    """The active span's context, for propagation into a TaskSpec."""
+    return _current.get()
+
+
+def _emit(record: dict):
+    global _file
+    if _trace_dir is None:
+        return
+    with _file_lock:
+        if _file is None:
+            _file = open(os.path.join(_trace_dir, f"{os.getpid()}.jsonl"),
+                         "a", buffering=1)
+        _file.write(json.dumps(record) + "\n")
+
+
+class span:
+    """Context manager recording one span; nests via contextvars and
+    parents across processes via an explicit ``parent`` ctx dict."""
+
+    def __init__(self, name: str, parent: Optional[Dict[str, str]] = None,
+                 **attributes: Any):
+        self.name = name
+        self.attributes = attributes
+        explicit = parent or _current.get()
+        self.trace_id = (explicit["trace_id"] if explicit
+                         else secrets.token_hex(16))
+        self.parent_id = explicit["span_id"] if explicit else None
+        self.span_id = secrets.token_hex(8)
+        self._token = None
+        self._t0 = 0.0
+
+    @property
+    def ctx(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def set_error(self, message: str):
+        """Mark the span failed without an exception crossing the with
+        block (e.g. a task error converted into an error reply)."""
+        self._error = message
+
+    def __enter__(self) -> "span":
+        self._t0 = time.time()
+        self._error: Optional[str] = None
+        self._token = _current.set(self.ctx)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _current.reset(self._token)
+        if not _enabled:
+            return False
+        end = time.time()
+        failed = exc_type is not None or self._error is not None
+        _emit({
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_us": int(self._t0 * 1e6),
+            "duration_us": int((end - self._t0) * 1e6),
+            "pid": os.getpid(),
+            "status": "ERROR" if failed else "OK",
+            **({"error": repr(exc) if exc is not None else self._error}
+               if failed else {}),
+            "attributes": self.attributes,
+        })
+        return False
+
+
+def submit_with_span(worker, spec, **attrs):
+    """Submit a TaskSpec under a 'task.submit' span (shared by remote
+    functions and actor methods); the span covers the actual submission
+    and its context propagates to the executing worker via the spec."""
+    if not _enabled:
+        return worker.submit_spec(spec)
+    with span(f"task.submit {spec.name}",
+              task_id=spec.task_id.hex(), **attrs) as sp:
+        spec.trace_ctx = sp.ctx
+        return worker.submit_spec(spec)
+
+
+def read_spans(trace_dir: Optional[str] = None):
+    """All spans recorded under the trace dir (tests/tooling)."""
+    trace_dir = trace_dir or _trace_dir or os.environ.get(_ENV)
+    out = []
+    if not trace_dir or not os.path.isdir(trace_dir):
+        return out
+    for name in sorted(os.listdir(trace_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(trace_dir, name)) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass
+    return out

@@ -1,0 +1,91 @@
+"""Tracing: spans around submit/execute with cross-process parenting.
+
+Reference behaviors: `python/ray/util/tracing/tracing_helper.py`
+(task invocation + in-function spans sharing one trace via propagated
+span context).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACE_DIR", str(tmp_path / "traces"))
+    tracing.enable_tracing(str(tmp_path / "traces"))
+    # fresh runtime so workers inherit the trace dir
+    ray_tpu.init(num_cpus=2)
+    yield str(tmp_path / "traces")
+    ray_tpu.shutdown()
+    tracing._enabled = False
+    tracing._trace_dir = None
+    with tracing._file_lock:
+        if tracing._file is not None:
+            tracing._file.close()
+            tracing._file = None
+
+
+def _wait_spans(trace_dir, pred, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = tracing.read_spans(trace_dir)
+        if pred(spans):
+            return spans
+        time.sleep(0.2)
+    return tracing.read_spans(trace_dir)
+
+
+def test_task_spans_share_a_trace(traced):
+    @ray_tpu.remote
+    def traced_fn(x):
+        return x + 1
+
+    assert ray_tpu.get(traced_fn.remote(1), timeout=30) == 2
+
+    spans = _wait_spans(
+        traced,
+        lambda s: any(x["name"] == "task.run traced_fn" for x in s)
+        and any(x["name"] == "task.submit traced_fn" for x in s))
+    submit = next(x for x in spans if x["name"] == "task.submit traced_fn")
+    run = next(x for x in spans if x["name"] == "task.run traced_fn")
+    # one distributed trace: the run span is a CHILD of the submit span
+    assert run["trace_id"] == submit["trace_id"]
+    assert run["parent_id"] == submit["span_id"]
+    assert run["pid"] != submit["pid"]
+    assert run["status"] == "OK"
+
+
+def test_actor_method_spans_and_error_status(traced):
+    @ray_tpu.remote
+    class A:
+        def ok(self):
+            return 1
+
+        def boom(self):
+            raise ValueError("nope")
+
+    a = A.remote()
+    assert ray_tpu.get(a.ok.remote(), timeout=30) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(a.boom.remote(), timeout=30)
+
+    spans = _wait_spans(
+        traced, lambda s: any(x["name"] == "task.run A.boom" for x in s))
+    ok_run = next(x for x in spans if x["name"] == "task.run A.ok")
+    assert ok_run["status"] == "OK"
+    boom_run = next(x for x in spans if x["name"] == "task.run A.boom")
+    assert boom_run["status"] == "ERROR"
+
+
+def test_nested_spans_inherit(traced):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = tracing.read_spans(traced)
+    names = [s["name"] for s in spans]
+    assert "outer" in names and "inner" in names
