@@ -1,0 +1,45 @@
+"""Hyperparameter sweep: ASHA early stopping + TPE bayesian search.
+
+Run:  python examples/tune_sweep.py
+"""
+
+import os
+import sys
+
+# allow running straight from a repo checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def objective(config):
+    from ray_tpu import tune
+
+    # a noisy quadratic standing in for a training curve
+    for step in range(10):
+        score = -(config["lr"] - 0.01) ** 2 * 1e4 + step * 0.1
+        tune.report({"score": score})
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import tune
+
+    ray_tpu.init()
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=12,
+            search_alg=tune.TPESearcher(n_initial_points=4, seed=0),
+            scheduler=tune.ASHAScheduler(metric="score", mode="max",
+                                         max_t=10, grace_period=2),
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.RunConfig(name="sweep_example"),
+    ).fit()
+    best = grid.get_best_result()
+    print("best lr:", best.config["lr"], "score:", best.metrics["score"])
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,7 @@ class GcsCore:
         self._lock = threading.RLock()
         self._persist_path = persist_path
         self._dirty = False
+        self._flush_lock = threading.Lock()
         # node_id(hex) -> {address:(host,port)|None, resources_total,
         #                  resources_available, store_path, alive,
         #                  last_heartbeat, hostname}
@@ -117,20 +118,36 @@ class GcsCore:
     def _write_snapshot(self):
         import pickle
 
-        with self._lock:
-            snap = pickle.dumps({
-                "kv": dict(self._kv),
-                "functions": dict(self._functions),
-                "actors": {k: dict(v) for k, v in self._actors.items()},
-                "named": dict(self._named),
-                "cluster_pgs": {k: {**v, "pending": set(v["pending"])}
-                                for k, v in self._cluster_pgs.items()},
-            }, protocol=5)
-            self._dirty = False
-        tmp = self._persist_path + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(snap)
-        os.replace(tmp, self._persist_path)
+        # One writer at a time: the periodic flusher and stop()'s final
+        # flush share a tmp path; unserialized concurrent writes could
+        # install interleaved garbage via os.replace.
+        with self._flush_lock:
+            # Shallow-copy the tables under the GCS lock (values are
+            # bytes/small dicts), then pickle + write OUTSIDE it so a
+            # multi-MB serialization never stalls heartbeats/scheduling.
+            # _dirty clears AT COPY TIME: mutations racing the write
+            # re-mark it and the next flush catches them; a FAILED write
+            # re-sets it so acknowledged state is never silently dropped.
+            with self._lock:
+                tables = {
+                    "kv": dict(self._kv),
+                    "functions": dict(self._functions),
+                    "actors": {k: dict(v) for k, v in self._actors.items()},
+                    "named": dict(self._named),
+                    "cluster_pgs": {k: {**v, "pending": set(v["pending"])}
+                                    for k, v in self._cluster_pgs.items()},
+                }
+                self._dirty = False
+            try:
+                snap = pickle.dumps(tables, protocol=5)
+                tmp = self._persist_path + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(snap)
+                os.replace(tmp, self._persist_path)
+            except BaseException:
+                with self._lock:
+                    self._dirty = True
+                raise
 
     def _start_flusher(self):
         def loop():

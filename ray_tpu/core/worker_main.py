@@ -208,18 +208,39 @@ def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):
         )
 
 
-async def _execute_async(worker: RemoteWorker, msg: dict):
-    from ray_tpu.util import tracing
+class _run_span:
+    """Shared task.run tracing wrapper for the sync and asyncio execution
+    paths (child span of the submit-side span; reference:
+    `_inject_tracing_into_function`, `tracing_helper.py:322`).  Call
+    ``done(ok)`` with the inner result so user exceptions converted into
+    error replies still mark the span ERROR."""
 
-    spec: TaskSpec = msg["spec"]
-    if tracing.tracing_enabled():
-        with tracing.span(f"task.run {spec.name}", parent=spec.trace_ctx,
-                          task_id=spec.task_id.hex(), kind=spec.kind) as sp:
-            ok = await _execute_async_inner(worker, msg)
-            if not ok:
-                sp.set_error("task raised (see error object)")
-        return
-    await _execute_async_inner(worker, msg)
+    def __init__(self, spec: TaskSpec):
+        from ray_tpu.util import tracing
+
+        self._sp = tracing.span(
+            f"task.run {spec.name}", parent=spec.trace_ctx,
+            task_id=spec.task_id.hex(), kind=spec.kind) \
+            if tracing.tracing_enabled() else None
+
+    def __enter__(self):
+        if self._sp is not None:
+            self._sp.__enter__()
+        return self
+
+    def done(self, ok: bool):
+        if self._sp is not None and not ok:
+            self._sp.set_error("task raised (see error object)")
+
+    def __exit__(self, *exc):
+        if self._sp is not None:
+            return self._sp.__exit__(*exc)
+        return False
+
+
+async def _execute_async(worker: RemoteWorker, msg: dict):
+    with _run_span(msg["spec"]) as rs:
+        rs.done(await _execute_async_inner(worker, msg))
 
 
 async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
@@ -244,22 +265,10 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
 
 
 def execute_task(worker: RemoteWorker, msg: dict):
-    spec: TaskSpec = msg["spec"]
-    from ray_tpu.util import tracing
-
-    if tracing.tracing_enabled():
-        # child span of the submit-side span (reference:
-        # `_inject_tracing_into_function`, `tracing_helper.py:322`)
-        with tracing.span(f"task.run {spec.name}", parent=spec.trace_ctx,
-                          task_id=spec.task_id.hex(),
-                          kind=spec.kind) as sp:
-            ok = _execute_task_inner(worker, msg)
-            if not ok:
-                # user exception already converted to an error reply —
-                # reflect it on the span (the with-block sees no raise)
-                sp.set_error("task raised (see error object)")
-            return ok
-    return _execute_task_inner(worker, msg)
+    with _run_span(msg["spec"]) as rs:
+        ok = _execute_task_inner(worker, msg)
+        rs.done(ok)
+        return ok
 
 
 def _execute_task_inner(worker: RemoteWorker, msg: dict):

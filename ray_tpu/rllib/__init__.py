@@ -20,6 +20,7 @@ combo).
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, make_vtrace_fn
@@ -39,7 +40,8 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig, compute_gae
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "DQN", "DQNConfig", "EnvRunner",
+    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "DQN", "DQNConfig",
+    "EnvRunner",
     "Impala", "ImpalaConfig", "PPO", "PPOConfig",
     "PrioritizedReplayBuffer", "ReplayBuffer", "SampleBatch",
     "compute_gae", "cnn_forward", "init_cnn_policy", "init_mlp_policy",

@@ -1,0 +1,109 @@
+"""BC — behavior cloning: offline RL from a dataset of expert transitions.
+
+Reference analogue: `rllib/algorithms/bc/bc.py` (+ the offline data path
+`rllib/offline/`).  TPU-first: the dataset is a ``ray_tpu.data.Dataset``
+(or columnar dict) of OBS/ACTIONS; training is jitted supervised
+cross-entropy on the learner chip; the EnvRunner actors only EVALUATE the
+cloned policy (no environment interaction is used for learning —
+offline).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import ACTIONS, OBS
+
+__all__ = ["BCConfig", "BC"]
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 64
+        self.hidden = (64, 64)
+        self.dataset = None  # ray_tpu.data.Dataset | {"obs":..., "actions":...}
+
+    def offline_data(self, dataset) -> "BCConfig":
+        self.dataset = dataset
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC(Algorithm):
+    _config_cls = BCConfig
+
+    def build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models import init_mlp_policy, policy_forward
+
+        cfg = self.algo_config
+        assert cfg.dataset is not None, "config.offline_data(...) missing"
+        if hasattr(cfg.dataset, "take_all"):  # ray_tpu.data.Dataset
+            rows = cfg.dataset.take_all()
+            obs = np.stack([r[OBS] for r in rows]).astype(np.float32)
+            acts = np.asarray([r[ACTIONS] for r in rows], np.int64)
+        else:
+            obs = np.asarray(cfg.dataset[OBS], np.float32)
+            acts = np.asarray(cfg.dataset[ACTIONS], np.int64)
+        self._obs, self._acts = obs, acts
+
+        env = cfg.env_creator()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        num_actions = int(env.action_space.n)
+        env.close()
+        self.params = init_mlp_policy(
+            jax.random.PRNGKey(cfg.seed), obs_dim, num_actions, cfg.hidden)
+        self._opt = optax.adam(cfg.lr)
+        self.opt_state = self._opt.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+
+        def update(params, opt_state, obs_b, act_b):
+            def loss_fn(params):
+                logits, _ = policy_forward(params, obs_b)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(
+                    logp, act_b[:, None], axis=-1)[:, 0]
+                return jnp.mean(nll)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = weights
+        self.opt_state = self._opt.init(self.params)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        losses = []
+        n = len(self._obs)
+        for _ in range(cfg.num_updates_per_iter):
+            idx = self._rng.integers(0, n, size=cfg.train_batch_size)
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, self._obs[idx],
+                self._acts[idx])
+            losses.append(float(loss))
+        # evaluation rollouts with the cloned policy (offline learning,
+        # online EVALUATION — like the reference's evaluation workers)
+        self.sync_weights()
+        self.synchronous_parallel_sample()
+        return {"loss": float(np.mean(losses)),
+                "_steps_this_iter": 0}

@@ -34,12 +34,14 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
     TrainingFailedError,
 )
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "JaxBackend",
     "JaxConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "TorchConfig", "TorchTrainer",
     "TrainingFailedError", "TrainingWorkerError", "WorkerGroup",
     "get_checkpoint", "get_context", "get_dataset_shard", "get_local_rank",
     "get_world_rank", "get_world_size", "report",

@@ -1,0 +1,157 @@
+"""Torch training backend: process-group bootstrap + DDP helpers.
+
+Reference analogue: `python/ray/train/torch/config.py:29` (``TorchConfig``),
+``_TorchBackend.on_start :158`` → ``_setup_torch_process_group :69`` (rank-0
+address broadcast, ``dist.init_process_group(nccl|gloo)``), and
+`train/torch/train_loop_utils.py:75` (``prepare_model`` → DDP wrap,
+``prepare_data_loader :116`` → DistributedSampler).
+
+In the TPU framework this is the CPU-torch compatibility path (the image
+ships torch CPU; the flagship accelerator path is ``JaxTrainer``): gloo
+process groups across the worker group, same Trainer/session plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig, _find_free_port
+from ray_tpu.train.trainer import DataParallelTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = ["TorchConfig", "TorchTrainer", "prepare_model",
+           "prepare_data_loader", "get_device"]
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"   # CPU image; "nccl" on CUDA hosts
+    init_port: Optional[int] = None
+    timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+    def worker_env(self):
+        return {}
+
+
+def _master_addr_port(port: Optional[int]):
+    import socket
+
+    return socket.gethostname(), (port or _find_free_port())
+
+
+def _setup_torch_process_group(backend: str, master_addr: str,
+                               master_port: int, rank: int,
+                               world_size: int, timeout_s: float):
+    """Runs inside each training worker (reference:
+    `train/torch/config.py:69`)."""
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master_addr}:{master_port}",
+        rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return dist.get_rank()
+
+
+def _shutdown_torch_process_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup,
+                 backend_config: TorchConfig):
+        if len(worker_group) <= 1:
+            return
+        addr, port = worker_group.execute_single(
+            0, _master_addr_port, backend_config.init_port)
+        import ray_tpu
+
+        futures = [
+            w.execute.remote(
+                _setup_torch_process_group, backend_config.backend,
+                addr, port, rank, len(worker_group),
+                backend_config.timeout_s)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ranks = ray_tpu.get(futures, timeout=300)
+        assert sorted(ranks) == list(range(len(worker_group)))
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: TorchConfig):
+        try:
+            worker_group.execute(_shutdown_torch_process_group)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# ------------------------------------------------------------ loop utils
+
+
+def get_device():
+    """The device this worker should use (reference:
+    ``train.torch.get_device``) — CPU in this image."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, parallel_strategy: str = "ddp"):
+    """Wrap in DDP when a process group is live (reference:
+    `train_loop_utils.py:75-98`)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel as DDP
+
+    model = model.to(get_device())
+    if parallel_strategy and dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        return DDP(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-create the DataLoader with a DistributedSampler so each rank
+    sees its shard (reference: `train_loop_utils.py:116`)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the torch process-group bootstrap
+    (reference: `python/ray/train/torch/torch_trainer.py`)."""
+
+    _default_backend_config = TorchConfig()
+
+    def __init__(self, train_loop_per_worker, *,
+                 torch_config: Optional[TorchConfig] = None, **kwargs):
+        kwargs.setdefault("backend_config", torch_config or TorchConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

@@ -1,0 +1,77 @@
+"""TorchTrainer: gloo process group over the worker group, DDP training.
+
+Reference behaviors: `python/ray/train/torch/config.py` (process-group
+bootstrap), `train_loop_utils.py` (prepare_model / prepare_data_loader),
+`torch_trainer.py` (TorchTrainer).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import ScalingConfig, TorchTrainer
+
+
+@pytest.fixture(scope="module")
+def ray(ray_shared):
+    return ray_shared
+
+
+def _torch_loop(config):
+    import torch
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from ray_tpu.train.torch import prepare_data_loader, prepare_model
+
+    torch.manual_seed(0)
+    # y = 3x + 1 regression
+    xs = torch.linspace(-1, 1, 256).unsqueeze(1)
+    ys = 3 * xs + 1
+    loader = DataLoader(TensorDataset(xs, ys), batch_size=32, shuffle=False)
+    loader = prepare_data_loader(loader)
+
+    model = prepare_model(torch.nn.Linear(1, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    loss_fn = torch.nn.MSELoss()
+    world = dist.get_world_size() if dist.is_initialized() else 1
+    for epoch in range(config.get("epochs", 20)):
+        if hasattr(loader, "sampler") and hasattr(loader.sampler,
+                                                  "set_epoch"):
+            loader.sampler.set_epoch(epoch)
+        total = 0.0
+        for bx, by in loader:
+            opt.zero_grad()
+            loss = loss_fn(model(bx), by)
+            loss.backward()  # DDP all-reduces grads across ranks
+            opt.step()
+            total += float(loss)
+        train.report({"loss": total, "world_size": world})
+
+
+def test_torch_trainer_ddp_two_workers(ray, tmp_path):
+    trainer = TorchTrainer(
+        _torch_loop,
+        train_loop_config={"epochs": 25},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=train.RunConfig(name="torch_ddp",
+                                   storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["world_size"] == 2
+    assert result.metrics["loss"] < 0.05
+
+
+def test_prepare_helpers_no_process_group():
+    """Outside a process group the helpers are passthroughs."""
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from ray_tpu.train.torch import prepare_data_loader, prepare_model
+
+    m = prepare_model(torch.nn.Linear(2, 2))
+    assert isinstance(m, torch.nn.Linear)  # no DDP wrap
+    dl = DataLoader(TensorDataset(torch.zeros(4, 2)), batch_size=2)
+    assert prepare_data_loader(dl) is dl
