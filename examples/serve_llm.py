@@ -13,8 +13,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    import argparse
     import json
+    import time
     import urllib.request
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--demo", action="store_true",
+                        help="make one request and exit (CI smoke mode) "
+                        "instead of serving until Ctrl-C")
+    args = parser.parse_args()
 
     import ray_tpu
     from ray_tpu import serve
@@ -61,7 +69,14 @@ def main():
         f"http://127.0.0.1:{port}/generate", data=body,
         headers={"Content-Type": "application/json"})
     print("response:", json.loads(urllib.request.urlopen(req).read()))
-    print(f"serving on http://127.0.0.1:{port}/generate (Ctrl-C to stop)")
+    if not args.demo:
+        print(f"serving on http://127.0.0.1:{port}/generate "
+              "(Ctrl-C to stop)")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
     serve.shutdown()
     ray_tpu.shutdown()
 

@@ -46,8 +46,11 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--workers", type=int, default=2)
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--tiny", action="store_true", default=True)
+    parser.add_argument("--full", action="store_true",
+                        help="train GPT-2 124M (default: the tiny config, "
+                        "sized for CPU smoke runs)")
     args = parser.parse_args()
+    args.tiny = not args.full
 
     import ray_tpu
     from ray_tpu.train import JaxConfig, JaxTrainer, RunConfig, ScalingConfig

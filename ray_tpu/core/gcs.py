@@ -134,8 +134,15 @@ class GcsCore:
                     "functions": dict(self._functions),
                     "actors": {k: dict(v) for k, v in self._actors.items()},
                     "named": dict(self._named),
-                    "cluster_pgs": {k: {**v, "pending": set(v["pending"])}
-                                    for k, v in self._cluster_pgs.items()},
+                    # copy the NESTED mutables too (assignments/bundles are
+                    # mutated in place by PG repair): pickling outside the
+                    # lock must never iterate a dict another thread edits
+                    "cluster_pgs": {
+                        k: {**v,
+                            "bundles": [dict(b) for b in v["bundles"]],
+                            "assignments": dict(v["assignments"]),
+                            "pending": set(v["pending"])}
+                        for k, v in self._cluster_pgs.items()},
                 }
                 self._dirty = False
             try:
@@ -155,13 +162,13 @@ class GcsCore:
                 if self._dirty:
                     try:
                         self._write_snapshot()
-                    except OSError:
-                        pass
+                    except Exception:  # noqa: BLE001 — flusher must live
+                        traceback.print_exc()
             if self._dirty:  # final flush on shutdown
                 try:
                     self._write_snapshot()
-                except OSError:
-                    pass
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
 
         threading.Thread(target=loop, name="gcs-persist",
                          daemon=True).start()

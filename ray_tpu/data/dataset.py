@@ -574,6 +574,34 @@ class Dataset:
             if BlockAccessor.for_block(merged).num_rows():
                 yield BlockAccessor.for_block(merged).to_batch(batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False,
+                           local_shuffle_buffer_size: Optional[int] = None,
+                           local_shuffle_seed: Optional[int] = None):
+        """Batches as dicts of torch tensors (reference:
+        ``Dataset.iter_torch_batches`` / `iterator.py`); columnar numpy
+        blocks convert zero-copy via ``torch.from_numpy``."""
+        import torch
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+                local_shuffle_seed=local_shuffle_seed):
+            out = {}
+            for k, v in batch.items():
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) \
+                        else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device != "cpu":
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_rows(self) -> Iterator[Any]:
         for eb in self._stream():
             yield from BlockAccessor.for_block(ray_tpu.get(eb.ref)).iter_rows()

@@ -109,6 +109,19 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """Object-store usage + object table (reference: ``ray memory``)."""
+    ray_tpu = _connect(args)
+    from ray_tpu.util import state
+
+    summary = state.summarize_objects()
+    print(json.dumps(summary, indent=1, default=str))
+    if args.verbose:
+        for row in state.list_objects(limit=args.limit):
+            print(json.dumps(row, default=str))
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -177,6 +190,12 @@ def main(argv=None) -> int:
     p.add_argument("what", choices=["nodes", "actors", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory", help="object store usage (ray memory)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("job", help="job submission (reference: ray job ...)")
     jsub = p.add_subparsers(dest="job_command", required=True)

@@ -32,6 +32,7 @@ __all__ = [
     "get_deployment_handle", "get_app_handle", "Deployment", "Application",
     "AutoscalingConfig", "DeploymentHandle", "batch", "batch_sizes_of",
     "get_multiplexed_model_id", "multiplexed",
+    "run_config",
 ]
 
 _state_lock = threading.Lock()
@@ -281,3 +282,50 @@ def shutdown():
             pass
         _started = False
         _http_port = None
+
+
+def run_config(config, *, blocking: bool = False):
+    """Deploy applications from a declarative config (reference: the
+    ``serve deploy`` YAML schema, `python/ray/serve/schema.py` —
+    ``applications: [{name, route_prefix, import_path}]``).  ``config``
+    is a dict, a YAML/JSON file path, or a YAML string; each
+    ``import_path`` is ``"module:attr"`` resolving to an Application (a
+    bound deployment) or a Deployment (bound with no args).
+    """
+    import importlib
+    import os as _os
+
+    if isinstance(config, str):
+        import yaml
+
+        if _os.path.exists(config):
+            with open(config) as f:
+                config = yaml.safe_load(f)
+        else:
+            config = yaml.safe_load(config)
+    apps = config.get("applications", [])
+    if not apps:
+        raise ValueError("config has no applications")
+    out = []
+    for app_cfg in apps:
+        module_name, _, attr = app_cfg["import_path"].partition(":")
+        target = getattr(importlib.import_module(module_name), attr)
+        if not isinstance(target, (Application, Deployment)):
+            raise TypeError(
+                f"{app_cfg['import_path']} resolved to {type(target)}; "
+                "expected an Application (deployment.bind()) or Deployment")
+        # run() normalizes Deployment -> Application
+        out.append(run(
+            target,
+            name=app_cfg.get("name", attr),
+            route_prefix=app_cfg.get("route_prefix", "/"),
+        ))
+    if blocking:
+        import time as _time
+
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return out

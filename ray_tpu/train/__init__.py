@@ -34,6 +34,7 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
     TrainingFailedError,
 )
+from ray_tpu.train.sklearn import SklearnTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
@@ -41,7 +42,7 @@ __all__ = [
     "Backend", "BackendConfig", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "DataParallelTrainer", "FailureConfig", "JaxBackend",
     "JaxConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
-    "TorchConfig", "TorchTrainer",
+    "SklearnTrainer", "TorchConfig", "TorchTrainer",
     "TrainingFailedError", "TrainingWorkerError", "WorkerGroup",
     "get_checkpoint", "get_context", "get_dataset_shard", "get_local_rank",
     "get_world_rank", "get_world_size", "report",

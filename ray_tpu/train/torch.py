@@ -134,12 +134,19 @@ def prepare_data_loader(data_loader):
     if not (dist.is_available() and dist.is_initialized()
             and dist.get_world_size() > 1):
         return data_loader
-    sampler = DistributedSampler(data_loader.dataset)
+    # Preserve the loader's ordering intent: a sequentially-sampled loader
+    # must stay ordered per shard (reference keeps the shuffle choice when
+    # re-wrapping).
+    from torch.utils.data import RandomSampler
+
+    was_shuffled = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=was_shuffled)
     return DataLoader(
         data_loader.dataset,
         batch_size=data_loader.batch_size,
         sampler=sampler,
-        num_workers=0,
+        num_workers=data_loader.num_workers,
+        pin_memory=data_loader.pin_memory,
         collate_fn=data_loader.collate_fn,
         drop_last=data_loader.drop_last,
     )

@@ -1,0 +1,12 @@
+"""Import target for the serve YAML-config test."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=1)
+class Echo:
+    def __call__(self, request):
+        return {"echo": request}
+
+
+app = Echo.bind()

@@ -336,3 +336,18 @@ def test_groupby_aggregates(ray_shared):
     assert counts == {"a": 5, "b": 5}
     sums = {r["key"]: r["sum"] for r in ds.groupby("k").sum("v").take_all()}
     assert sums == {"a": 1 + 3 + 5 + 7 + 9, "b": 0 + 2 + 4 + 6 + 8}
+
+
+def test_iter_torch_batches(ray):
+    """Torch-tensor batches off columnar blocks (reference:
+    ``Dataset.iter_torch_batches``)."""
+    import torch
+
+    ds = rd.from_numpy(np.arange(10, dtype=np.float32))
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert all(isinstance(b["value"], torch.Tensor) for b in batches)
+    got = torch.cat([b["value"] for b in batches])
+    assert torch.equal(got, torch.arange(10, dtype=torch.float32))
+    # dtype coercion
+    b = next(ds.iter_torch_batches(batch_size=10, dtypes=torch.int64))
+    assert b["value"].dtype == torch.int64

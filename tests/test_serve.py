@@ -449,3 +449,18 @@ class TestMultiplexing:
         # get_multiplexed_model_id() outside a request context is empty
         assert serve.get_multiplexed_model_id() == ""
         serve.delete("mux2")
+
+
+def test_run_config_yaml(served, tmp_path):
+    """Declarative YAML deploy (reference: `serve deploy` +
+    `python/ray/serve/schema.py`)."""
+    cfg = tmp_path / "app.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: yamlapp\n"
+        "    route_prefix: /yaml\n"
+        "    import_path: serve_assets.yaml_app:app\n")
+    serve.run_config(str(cfg))
+    status, body = _http("/yaml", {"x": 1})
+    assert status == 200 and body == {"echo": {"x": 1}}
+    serve.delete("yamlapp")

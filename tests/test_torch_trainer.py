@@ -75,3 +75,30 @@ def test_prepare_helpers_no_process_group():
     assert isinstance(m, torch.nn.Linear)  # no DDP wrap
     dl = DataLoader(TensorDataset(torch.zeros(4, 2)), batch_size=2)
     assert prepare_data_loader(dl) is dl
+
+
+def test_sklearn_trainer(ray, tmp_path):
+    """SklearnTrainer fits an estimator on Dataset rows and checkpoints it
+    (reference: `python/ray/train/sklearn/sklearn_trainer.py`)."""
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    df = pd.DataFrame({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y})
+    ds = data.from_pandas(df, parallelism=2)
+
+    result = SklearnTrainer(
+        LogisticRegression(),
+        label_column="label",
+        datasets={"train": ds, "valid": ds},
+        cv=3,
+    ).fit()
+    assert result.metrics["train/score"] > 0.9
+    assert result.metrics["cv/mean_test_score"] > 0.85
+    model = SklearnTrainer.get_model(result.checkpoint)
+    assert model.predict(X[:5]).shape == (5,)
