@@ -161,6 +161,24 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve control (reference: `serve deploy/status/shutdown` CLI)."""
+    _connect(args)
+    from ray_tpu import serve
+
+    if args.serve_command == "deploy":
+        serve.start()
+        apps = serve.run_config(args.config)
+        print(f"deployed {len(apps)} application(s); "
+              f"http port {serve.http_port()}")
+    elif args.serve_command == "status":
+        print(json.dumps(serve.status(), indent=1, default=str))
+    elif args.serve_command == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     ray_tpu = _connect(args)
     events = ray_tpu.timeline(args.out)
@@ -213,6 +231,17 @@ def main(argv=None) -> int:
     jl = jsub.add_parser("list")
     jl.add_argument("--address", required=True)
     jl.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="model serving control")
+    ssub = p.add_subparsers(dest="serve_command", required=True)
+    sd = ssub.add_parser("deploy")
+    sd.add_argument("--address", required=True)
+    sd.add_argument("config", help="YAML/JSON app config")
+    sd.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        sp = ssub.add_parser(name)
+        sp.add_argument("--address", required=True)
+        sp.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("timeline", help="chrome://tracing dump")
     p.add_argument("--address", required=True)

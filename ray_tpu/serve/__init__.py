@@ -145,18 +145,27 @@ def start(http_host: str = "127.0.0.1", http_port: int = 0,
             return
         if not ray_tpu.is_initialized():
             ray_tpu.init()
-        ctrl_cls = ray_tpu.remote(
-            num_cpus=0.1, name=CONTROLLER_NAME, namespace=NAMESPACE,
-            # long-poll listeners each hold a concurrency slot while parked
-            max_concurrency=64,
-        )(ServeController)
-        ctrl = ctrl_cls.remote()
-        if with_proxy:
-            proxy_cls = ray_tpu.remote(
-                num_cpus=0.1, name=PROXY_NAME, namespace=NAMESPACE,
+        # Attach-or-create: a second driver (e.g. the `serve deploy` CLI
+        # run twice) must reuse the live controller, not collide on the
+        # actor name.
+        try:
+            ctrl = ray_tpu.get_actor(CONTROLLER_NAME, namespace=NAMESPACE)
+        except ValueError:
+            ctrl_cls = ray_tpu.remote(
+                num_cpus=0.1, name=CONTROLLER_NAME, namespace=NAMESPACE,
+                # long-poll listeners each hold a slot while parked
                 max_concurrency=64,
-            )(HTTPProxy)
-            proxy = proxy_cls.remote(http_host, http_port)
+            )(ServeController)
+            ctrl = ctrl_cls.remote()
+        if with_proxy:
+            try:
+                proxy = ray_tpu.get_actor(PROXY_NAME, namespace=NAMESPACE)
+            except ValueError:
+                proxy_cls = ray_tpu.remote(
+                    num_cpus=0.1, name=PROXY_NAME, namespace=NAMESPACE,
+                    max_concurrency=64,
+                )(HTTPProxy)
+                proxy = proxy_cls.remote(http_host, http_port)
             _http_port = ray_tpu.get(proxy.get_port.remote(), timeout=30)
         ray_tpu.get(ctrl.status.remote(), timeout=30)  # wait alive
         _started = True
@@ -239,6 +248,17 @@ def run(app: Application, *, name: str = "default",
         ray_tpu.get(proxy.update_routes.remote(routing["routes"]), timeout=10)
     except ValueError:
         pass  # proxy-less mode
+    # Application record (GCS KV): app name -> its deployment names, so
+    # delete()/status by APP name works from any process (reference:
+    # application-level state in the serve controller).
+    import json as _json
+
+    from ray_tpu.core.worker import global_worker
+
+    global_worker().kv_put(
+        name.encode(),
+        _json.dumps([s["name"] for s in specs]).encode(),
+        namespace="serve_apps")
     return DeploymentHandle(ingress["name"])
 
 
@@ -256,8 +276,23 @@ def status() -> dict:
 
 
 def delete(name: str):
-    import ray_tpu
+    """Delete by APPLICATION name (removing all its deployments) or by a
+    single deployment name."""
+    import json as _json
 
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    w = global_worker()
+    raw = w.kv_get(name.encode(), namespace="serve_apps")
+    if raw is not None:
+        ok = True
+        for dep in _json.loads(raw):
+            ok = ray_tpu.get(
+                _controller().delete_deployment.remote(dep),
+                timeout=30) and ok
+        w.kv_del(name.encode(), namespace="serve_apps")
+        return ok
     return ray_tpu.get(_controller().delete_deployment.remote(name),
                        timeout=30)
 
@@ -267,7 +302,10 @@ def shutdown():
     import ray_tpu
 
     with _state_lock:
-        if not _started:
+        # No early-exit on _started: a FRESH process (the `serve shutdown`
+        # CLI) must still be able to tear down a live serve instance on
+        # the cluster it attached to.
+        if not ray_tpu.is_initialized():
             return
         try:
             ray_tpu.get(_controller().shutdown.remote(), timeout=30)

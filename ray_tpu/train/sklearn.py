@@ -34,8 +34,7 @@ def _collect_xy(dataset, label_column: str, feature_columns):
 
 
 def _fit_task(estimator_blob: bytes, datasets_rows: dict,
-              label_column: str, feature_columns, cv: Optional[int],
-              scoring: Optional[str]):
+              cv: Optional[int], scoring: Optional[str]):
     import pickle
     import time
 
@@ -50,9 +49,7 @@ def _fit_task(estimator_blob: bytes, datasets_rows: dict,
     if cv:
         from sklearn.model_selection import cross_val_score
 
-        import cloudpickle as cp
-
-        fresh = cp.loads(estimator_blob)
+        fresh = cloudpickle.loads(estimator_blob)
         scores = cross_val_score(fresh, X, y, cv=cv, scoring=scoring)
         metrics["cv/mean_test_score"] = float(np.mean(scores))
         metrics["cv/std_test_score"] = float(np.std(scores))
@@ -86,15 +83,19 @@ class SklearnTrainer:
 
         import ray_tpu
 
+        # Column order is inferred ONCE from the train split and applied
+        # to every other split — per-dataset inference could silently
+        # permute valid/test feature matrices.
+        _, _, train_cols = _collect_xy(
+            self._datasets["train"], self._label, self._features)
         rows = {
-            name: _collect_xy(ds, self._label, self._features)
+            name: _collect_xy(ds, self._label, train_cols)
             for name, ds in self._datasets.items()
         }
         fit_remote = ray_tpu.remote(num_cpus=self._num_cpus)(_fit_task)
         metrics, model_blob, cols = ray_tpu.get(
             fit_remote.remote(cloudpickle.dumps(self._estimator), rows,
-                              self._label, self._features, self._cv,
-                              self._scoring),
+                              self._cv, self._scoring),
             timeout=600)
         ckpt = Checkpoint.from_dict({
             _MODEL_KEY: model_blob,

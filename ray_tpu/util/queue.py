@@ -1,0 +1,151 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference analogue: `python/ray/util/queue.py` (``Queue`` — an actor
+wrapping asyncio.Queue with blocking/non-blocking put/get across
+processes).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+__all__ = ["Queue", "Empty", "Full"]
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self._maxsize = maxsize
+        self._items: deque = deque()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return self._maxsize > 0 and len(self._items) >= self._maxsize
+
+    def put(self, item) -> bool:
+        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items: List[Any]) -> int:
+        n = 0
+        for item in items:
+            if not self.put(item):
+                break
+            n += 1
+        return n
+
+    def get(self):
+        if not self._items:
+            return False, None
+        return True, self._items.popleft()
+
+    def get_batch(self, n: int):
+        out = []
+        while self._items and len(out) < n:
+            out.append(self._items.popleft())
+        return out
+
+
+class Queue:
+    """``Queue(maxsize=0)`` — unbounded by default; handles are
+    serializable, so producers/consumers can live in any task or actor."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        import ray_tpu
+
+        self.maxsize = maxsize
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency", 8)
+        self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    # ------------------------------------------------------------- inspect
+
+    def qsize(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.full.remote())
+
+    # ------------------------------------------------------------- put/get
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None):
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        import ray_tpu
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]):
+        import ray_tpu
+
+        n = ray_tpu.get(self._actor.put_batch.remote(list(items)))
+        if n < len(items):
+            raise Full(f"only {n}/{len(items)} items fit")
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        import ray_tpu
+
+        return ray_tpu.get(self._actor.get_batch.remote(n))
+
+    def shutdown(self):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:  # noqa: BLE001
+            pass

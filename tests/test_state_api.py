@@ -2,6 +2,7 @@
 `python/ray/scripts/scripts.py:540`)."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -65,3 +66,46 @@ def test_cli_status_and_list_on_cluster():
         assert out.returncode == 0, out.stderr[-400:]
         row = json.loads(out.stdout.strip().splitlines()[0])
         assert row["state"] == "ALIVE"
+
+
+def test_cli_serve_deploy_status_and_memory(tmp_path):
+    """serve deploy/status + memory CLI subcommands (reference: `serve
+    deploy` CLI + `ray memory`)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cfg = tmp_path / "app.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: cliapp\n"
+        "    route_prefix: /cli\n"
+        "    import_path: serve_assets.yaml_app:app\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         os.path.dirname(os.path.abspath(__file__)),
+         env.get("PYTHONPATH", "")])
+    with Cluster(initialize_head=True,
+                 head_resources={"num_cpus": 4}) as c:
+        c.wait_for_nodes(1)
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "serve", "deploy",
+             "--address", c.address, str(cfg)],
+            capture_output=True, text=True, timeout=180, env=env)
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "deployed 1 application" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "serve", "status",
+             "--address", c.address],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert out.returncode == 0, out.stderr[-400:]
+        assert "Echo" in out.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "memory",
+             "--address", c.address],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert out.returncode == 0, out.stderr[-400:]
+        assert "total" in out.stdout

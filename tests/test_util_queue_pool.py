@@ -1,0 +1,83 @@
+"""util.Queue (actor-backed FIFO) + util.ActorPool.
+
+Reference behaviors: `python/ray/util/queue.py`,
+`python/ray/util/actor_pool.py`.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def ray(ray_shared):
+    return ray_shared
+
+
+def test_queue_fifo_cross_process(ray):
+    q = Queue()
+    try:
+        q.put(1)
+        q.put(2)
+
+        @ray_tpu.remote
+        def producer(q):
+            q.put(3)
+            return True
+
+        assert ray_tpu.get(producer.remote(q), timeout=30)
+        assert [q.get(timeout=10) for _ in range(3)] == [1, 2, 3]
+        assert q.empty()
+        with pytest.raises(Empty):
+            q.get_nowait()
+    finally:
+        q.shutdown()
+
+
+def test_queue_maxsize_and_batches(ray):
+    q = Queue(maxsize=2)
+    try:
+        q.put(1)
+        q.put(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        with pytest.raises(Full):
+            q.put(3, timeout=0.2)
+        assert q.get_nowait_batch(2) == [1, 2]
+        q.put_nowait_batch([4, 5])
+        assert q.qsize() == 2
+    finally:
+        q.shutdown()
+
+
+def test_actor_pool_ordered_and_unordered(ray):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    actors = [Doubler.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    assert list(pool.map(lambda a, v: a.double.remote(v), range(6))) == \
+        [0, 2, 4, 6, 8, 10]
+    got = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(6)))
+    assert got == [0, 2, 4, 6, 8, 10]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_submit_get_next(ray):
+    @ray_tpu.remote
+    class Id:
+        def f(self, x):
+            return x
+
+    pool = ActorPool([Id.remote()])
+    pool.submit(lambda a, v: a.f.remote(v), "a")
+    assert not pool.has_free()
+    assert pool.get_next(timeout=30) == "a"
+    assert pool.has_free()
