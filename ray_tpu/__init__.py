@@ -177,6 +177,14 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     return global_worker().wait(refs, num_returns=num_returns, timeout=timeout)
 
 
+def get_runtime_context():
+    """Where am I running? (reference: ``ray.get_runtime_context``,
+    `python/ray/runtime_context.py`)."""
+    from ray_tpu.runtime_context import get_runtime_context as _grc
+
+    return _grc()
+
+
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
     """Best-effort cancel of a pending task (running tasks finish)."""
     w = global_worker()

@@ -606,6 +606,7 @@ class Raylet:
                 k, v = kv.split("=", 1)
                 env[k] = v
         env["RAY_TPU_WORKER_PROFILE"] = profile
+        env["RAY_TPU_NODE_ID"] = self.node_id
         cmd = [
             sys.executable,
             "-m",

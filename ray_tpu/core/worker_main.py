@@ -245,6 +245,9 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
 
 async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
     spec: TaskSpec = msg["spec"]
+    from ray_tpu.runtime_context import _current_task_id
+
+    _ctx_token = _current_task_id.set(spec.task_id)
     try:
         args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
         result = await getattr(worker.actor_instance, spec.method_name)(
@@ -262,6 +265,8 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
             "error": err, "retryable": spec.retry_exceptions,
         })
         return False
+    finally:
+        _current_task_id.reset(_ctx_token)
 
 
 def execute_task(worker: RemoteWorker, msg: dict):
@@ -273,6 +278,9 @@ def execute_task(worker: RemoteWorker, msg: dict):
 
 def _execute_task_inner(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
+    from ray_tpu.runtime_context import _current_task_id
+
+    _ctx_token = _current_task_id.set(spec.task_id)
     try:
         _apply_runtime_env(spec)
         args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
@@ -317,6 +325,8 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
             "error": err, "retryable": spec.retry_exceptions,
         })
         return False
+    finally:
+        _current_task_id.reset(_ctx_token)
 
 
 class _PrefixStream:

@@ -574,6 +574,49 @@ class Dataset:
             if BlockAccessor.for_block(merged).num_rows():
                 yield BlockAccessor.for_block(merged).to_batch(batch_format)
 
+    def write_parquet(self, path: str) -> List[str]:
+        """One parquet file per block under ``path`` (reference:
+        ``Dataset.write_parquet`` / `data/datasource/parquet_datasink`);
+        runs as distributed tasks, returns the written file paths."""
+        return self._write_files(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        """One CSV file per block (reference: ``Dataset.write_csv``)."""
+        return self._write_files(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        """One JSON-lines file per block (reference:
+        ``Dataset.write_json``)."""
+        return self._write_files(path, "json")
+
+    def _write_files(self, path: str, fmt: str) -> List[str]:
+        import os as _os
+
+        import ray_tpu
+
+        _os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def write_block(block: Block, out_path: str, fmt: str) -> str:
+            acc = BlockAccessor.for_block(block)
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(acc.to_batch("pyarrow"), out_path)
+            elif fmt == "csv":
+                acc.to_batch("pandas").to_csv(out_path, index=False)
+            else:  # json lines
+                acc.to_batch("pandas").to_json(out_path, orient="records",
+                                               lines=True)
+            return out_path
+
+        ext = {"parquet": "parquet", "csv": "csv", "json": "json"}[fmt]
+        refs = []
+        for i, eb in enumerate(self._stream()):
+            out_path = _os.path.join(path, f"part-{i:05d}.{ext}")
+            refs.append(write_block.remote(eb.ref, out_path, fmt))
+        return ray_tpu.get(refs, timeout=600)
+
     def iter_torch_batches(self, *, batch_size: int = 256,
                            dtypes=None, device: str = "cpu",
                            drop_last: bool = False,
@@ -978,17 +1021,8 @@ class Dataset:
     def to_numpy_refs(self) -> List[Any]:
         return [eb.ref for eb in self.materialize()._stream()]
 
-    def write_parquet(self, path: str):
-        import os
-
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
-        os.makedirs(path, exist_ok=True)
-        for i, eb in enumerate(self._stream()):
-            tbl = BlockAccessor.for_block(
-                ray_tpu.get(eb.ref)).to_batch("pyarrow")
-            pq.write_table(tbl, os.path.join(path, f"part-{i:05d}.parquet"))
+    # (write_parquet/write_csv/write_json are defined with the other IO
+    # methods above — distributed one-task-per-block writers)
 
     # ------------------------------------------------------------ misc
 

@@ -1,0 +1,54 @@
+"""Runtime context: where am I running?
+
+Reference analogue: `python/ray/runtime_context.py`
+(``ray.get_runtime_context()`` → node id, worker id, task id, actor id).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import Optional
+
+__all__ = ["RuntimeContext", "get_runtime_context"]
+
+#: set by the worker's execute paths around each task
+_current_task_id: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_current_task_id", default=None)
+
+
+class RuntimeContext:
+    def get_node_id(self) -> Optional[str]:
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        if w.mode == "driver":
+            return w.raylet.node_id
+        if w.mode == "client":
+            return getattr(w, "node_id", None)
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    def get_worker_id(self) -> str:
+        from ray_tpu.core.worker import global_worker
+
+        return global_worker().worker_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        """Inside a task: its TaskID hex; None on the driver."""
+        tid = _current_task_id.get()
+        return tid.hex() if tid is not None else None
+
+    def get_actor_id(self) -> Optional[str]:
+        """Inside an actor method: the hosting actor's id."""
+        from ray_tpu.core.worker import global_worker
+
+        aid = getattr(global_worker(), "current_actor_id", None)
+        return aid.hex() if aid is not None else None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return bool(int(os.environ.get("RAY_TPU_ACTOR_RESTARTS", "0")))
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -292,9 +292,27 @@ def delete(name: str):
                 _controller().delete_deployment.remote(dep),
                 timeout=30) and ok
         w.kv_del(name.encode(), namespace="serve_apps")
+        _push_routes_to_proxy()
         return ok
-    return ray_tpu.get(_controller().delete_deployment.remote(name),
-                       timeout=30)
+    result = ray_tpu.get(_controller().delete_deployment.remote(name),
+                         timeout=30)
+    _push_routes_to_proxy()
+    return result
+
+
+def _push_routes_to_proxy():
+    """Sync the proxy's route table with the controller (the proxy holds a
+    pushed copy; deletions must push too, or stale prefixes route to dead
+    deployments and hang instead of 404ing)."""
+    import ray_tpu
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=NAMESPACE)
+        routing = ray_tpu.get(_controller().get_routing.remote(), timeout=10)
+        ray_tpu.get(proxy.update_routes.remote(routing["routes"]),
+                    timeout=10)
+    except Exception:  # noqa: BLE001 — proxy-less mode / teardown races
+        pass
 
 
 def shutdown():

@@ -86,12 +86,14 @@ class SklearnTrainer:
         # Column order is inferred ONCE from the train split and applied
         # to every other split — per-dataset inference could silently
         # permute valid/test feature matrices.
-        _, _, train_cols = _collect_xy(
-            self._datasets["train"], self._label, self._features)
-        rows = {
+        train_xy = _collect_xy(self._datasets["train"], self._label,
+                               self._features)
+        train_cols = train_xy[2]
+        rows = {"train": train_xy}
+        rows.update({
             name: _collect_xy(ds, self._label, train_cols)
-            for name, ds in self._datasets.items()
-        }
+            for name, ds in self._datasets.items() if name != "train"
+        })
         fit_remote = ray_tpu.remote(num_cpus=self._num_cpus)(_fit_task)
         metrics, model_blob, cols = ray_tpu.get(
             fit_remote.remote(cloudpickle.dumps(self._estimator), rows,

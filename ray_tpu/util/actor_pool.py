@@ -41,16 +41,25 @@ class ActorPool:
         self._idle.append(actor)
 
     def get_next(self, timeout: float = None):
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order.  On timeout the pending ref is
+        kept (retry get_next later); the actor stays busy."""
         import ray_tpu
 
         if not self._pending_order:
             raise StopIteration
-        ref = self._pending_order.pop(0)
+        ref = self._pending_order[0]
         try:
-            return ray_tpu.get(ref, timeout=timeout)
-        finally:
+            result = ray_tpu.get(ref, timeout=timeout)
+        except TimeoutError:
+            raise  # still running: keep the ref pending, actor stays busy
+        except Exception:
+            # the task FAILED: it is finished, so free the actor
+            self._pending_order.pop(0)
             self._recycle(ref)
+            raise
+        self._pending_order.pop(0)
+        self._recycle(ref)
+        return result
 
     def get_next_unordered(self, timeout: float = None):
         """Next COMPLETED result, whichever actor finishes first."""

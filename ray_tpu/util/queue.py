@@ -1,13 +1,12 @@
-"""Distributed FIFO queue backed by an actor.
+"""Distributed FIFO queue backed by an asyncio actor.
 
 Reference analogue: `python/ray/util/queue.py` (``Queue`` — an actor
-wrapping asyncio.Queue with blocking/non-blocking put/get across
-processes).
+wrapping asyncio.Queue; blocking callers park INSIDE the actor, so a
+blocked get/put costs one outstanding actor call, not a poll loop).
 """
 
 from __future__ import annotations
 
-import time
 from typing import Any, List, Optional
 
 __all__ = ["Queue", "Empty", "Full"]
@@ -22,44 +21,75 @@ class Full(Exception):
 
 
 class _QueueActor:
+    """Coroutine methods run on the actor's asyncio loop — single-threaded,
+    so the queue state is race-free even with many parked callers."""
+
     def __init__(self, maxsize: int):
-        from collections import deque
+        import asyncio
 
-        self._maxsize = maxsize
-        self._items: deque = deque()
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
 
-    def qsize(self) -> int:
-        return len(self._items)
+    async def qsize(self) -> int:
+        return self._q.qsize()
 
-    def empty(self) -> bool:
-        return not self._items
+    async def empty(self) -> bool:
+        return self._q.empty()
 
-    def full(self) -> bool:
-        return self._maxsize > 0 and len(self._items) >= self._maxsize
+    async def full(self) -> bool:
+        return self._q.full()
 
-    def put(self, item) -> bool:
-        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+    async def put(self, item, timeout: Optional[float]) -> bool:
+        """timeout None = wait forever; 0 = non-blocking."""
+        import asyncio
+
+        if timeout == 0:
+            try:
+                self._q.put_nowait(item)
+                return True
+            except asyncio.QueueFull:
+                return False
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
             return False
-        self._items.append(item)
+
+    async def put_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing: no partial enqueue on overflow."""
+        import asyncio
+
+        if self._q.maxsize > 0 and \
+                self._q.qsize() + len(items) > self._q.maxsize:
+            return False
+        for item in items:
+            try:
+                self._q.put_nowait(item)
+            except asyncio.QueueFull:  # pragma: no cover — capacity checked
+                return False
         return True
 
-    def put_batch(self, items: List[Any]) -> int:
-        n = 0
-        for item in items:
-            if not self.put(item):
-                break
-            n += 1
-        return n
+    async def get(self, timeout: Optional[float]):
+        import asyncio
 
-    def get(self):
-        if not self._items:
+        if timeout == 0:
+            try:
+                return True, self._q.get_nowait()
+            except asyncio.QueueEmpty:
+                return False, None
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
             return False, None
-        return True, self._items.popleft()
 
-    def get_batch(self, n: int):
+    async def get_batch(self, n: int):
+        import asyncio
+
         out = []
-        while self._items and len(out) < n:
-            out.append(self._items.popleft())
+        while len(out) < n:
+            try:
+                out.append(self._q.get_nowait())
+            except asyncio.QueueEmpty:
+                break
         return out
 
 
@@ -67,13 +97,15 @@ class Queue:
     """``Queue(maxsize=0)`` — unbounded by default; handles are
     serializable, so producers/consumers can live in any task or actor."""
 
-    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+    def __init__(self, maxsize: int = 0,
+                 *, actor_options: Optional[dict] = None):
         import ray_tpu
 
         self.maxsize = maxsize
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
-        opts.setdefault("max_concurrency", 8)
+        # each PARKED blocking caller holds one concurrency slot
+        opts.setdefault("max_concurrency", 64)
         self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
             maxsize)
 
@@ -100,15 +132,10 @@ class Queue:
             timeout: Optional[float] = None):
         import ray_tpu
 
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            if ray_tpu.get(self._actor.put.remote(item)):
-                return
-            if not block:
-                raise Full
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Full
-            time.sleep(0.01)
+        t = 0 if not block else timeout
+        ok = ray_tpu.get(self._actor.put.remote(item, t))
+        if not ok:
+            raise Full
 
     def put_nowait(self, item):
         self.put(item, block=False)
@@ -116,16 +143,11 @@ class Queue:
     def get(self, block: bool = True, timeout: Optional[float] = None):
         import ray_tpu
 
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            ok, item = ray_tpu.get(self._actor.get.remote())
-            if ok:
-                return item
-            if not block:
-                raise Empty
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Empty
-            time.sleep(0.01)
+        t = 0 if not block else timeout
+        ok, item = ray_tpu.get(self._actor.get.remote(t))
+        if not ok:
+            raise Empty
+        return item
 
     def get_nowait(self):
         return self.get(block=False)
@@ -133,9 +155,8 @@ class Queue:
     def put_nowait_batch(self, items: List[Any]):
         import ray_tpu
 
-        n = ray_tpu.get(self._actor.put_batch.remote(list(items)))
-        if n < len(items):
-            raise Full(f"only {n}/{len(items)} items fit")
+        if not ray_tpu.get(self._actor.put_batch.remote(list(items))):
+            raise Full(f"{len(items)} items do not fit")
 
     def get_nowait_batch(self, n: int) -> List[Any]:
         import ray_tpu

@@ -167,3 +167,36 @@ def test_get_timeout(ray_shared):
 def test_cluster_resources(ray_shared):
     ray = ray_shared
     assert ray.cluster_resources()["CPU"] == 8.0
+
+
+def test_runtime_context(ray_shared):
+    """ray_shared.get_runtime_context() inside tasks/actors (reference:
+    `python/ray/runtime_context.py`)."""
+
+    @ray_shared.remote
+    def whereami():
+        ctx = ray_shared.get_runtime_context()
+        return {"task_id": ctx.get_task_id(),
+                "node_id": ctx.get_node_id(),
+                "worker_id": ctx.get_worker_id(),
+                "actor_id": ctx.get_actor_id()}
+
+    info = ray_shared.get(whereami.remote(), timeout=30)
+    assert info["task_id"] is not None and len(info["task_id"]) > 8
+    assert info["node_id"] is not None
+    assert info["worker_id"]
+    assert info["actor_id"] is None  # plain task, no actor
+
+    @ray_shared.remote
+    class Who:
+        def me(self):
+            ctx = ray_shared.get_runtime_context()
+            return ctx.get_actor_id(), ctx.get_task_id()
+
+    a = Who.remote()
+    actor_id, task_id = ray_shared.get(a.me.remote(), timeout=30)
+    assert actor_id is not None and task_id is not None
+    # driver context: no task, but a node
+    drv = ray_shared.get_runtime_context()
+    assert drv.get_task_id() is None
+    assert drv.get_node_id() is not None
