@@ -89,6 +89,12 @@ class DashboardHead:
         }
         if path in api:
             return json.dumps(api[path](), default=str), "application/json"
+        if path.startswith("/api/jobs/") and path.endswith("/logs"):
+            job_id = path[len("/api/jobs/"):-len("/logs")]
+            raw = self._gcs.kv_get("jobs", (job_id + "/logs").encode())
+            if raw is None:
+                raise KeyError(path)
+            return raw, "text/plain"
         raise KeyError(path)
 
     # ------------------------------------------------------------- sources

@@ -574,22 +574,24 @@ class Dataset:
             if BlockAccessor.for_block(merged).num_rows():
                 yield BlockAccessor.for_block(merged).to_batch(batch_format)
 
-    def write_parquet(self, path: str) -> List[str]:
+    def write_parquet(self, path: str,
+                      timeout_s: float = 600.0) -> List[str]:
         """One parquet file per block under ``path`` (reference:
         ``Dataset.write_parquet`` / `data/datasource/parquet_datasink`);
         runs as distributed tasks, returns the written file paths."""
-        return self._write_files(path, "parquet")
+        return self._write_files(path, "parquet", timeout_s)
 
-    def write_csv(self, path: str) -> List[str]:
+    def write_csv(self, path: str, timeout_s: float = 600.0) -> List[str]:
         """One CSV file per block (reference: ``Dataset.write_csv``)."""
-        return self._write_files(path, "csv")
+        return self._write_files(path, "csv", timeout_s)
 
-    def write_json(self, path: str) -> List[str]:
+    def write_json(self, path: str, timeout_s: float = 600.0) -> List[str]:
         """One JSON-lines file per block (reference:
         ``Dataset.write_json``)."""
-        return self._write_files(path, "json")
+        return self._write_files(path, "json", timeout_s)
 
-    def _write_files(self, path: str, fmt: str) -> List[str]:
+    def _write_files(self, path: str, fmt: str,
+                     timeout_s: float = 600.0) -> List[str]:
         import os as _os
 
         import ray_tpu
@@ -610,12 +612,11 @@ class Dataset:
                                                lines=True)
             return out_path
 
-        ext = {"parquet": "parquet", "csv": "csv", "json": "json"}[fmt]
         refs = []
         for i, eb in enumerate(self._stream()):
-            out_path = _os.path.join(path, f"part-{i:05d}.{ext}")
+            out_path = _os.path.join(path, f"part-{i:05d}.{fmt}")
             refs.append(write_block.remote(eb.ref, out_path, fmt))
-        return ray_tpu.get(refs, timeout=600)
+        return ray_tpu.get(refs, timeout=timeout_s)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            dtypes=None, device: str = "cpu",

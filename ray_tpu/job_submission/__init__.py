@@ -90,8 +90,22 @@ class _JobSupervisor:
         return json.loads(raw) if raw else {}
 
     def _pump_logs(self):
+        import time as _time
+
+        last_flush = 0.0
         for line in self._proc.stdout:
             self._log_chunks.append(line)
+            # Periodic partial flush: the dashboard's logs endpoint reads
+            # the KV, so live jobs are tail-able over HTTP too.
+            now = _time.monotonic()
+            if now - last_flush > 2.0:
+                last_flush = now
+                try:
+                    self._worker.kv_put(
+                        (self._id + "/logs").encode(),
+                        "".join(self._log_chunks).encode(), namespace=_NS)
+                except Exception:  # noqa: BLE001
+                    pass
         rc = self._proc.wait()
         info = self._get_info()
         info["end_time"] = time.time()

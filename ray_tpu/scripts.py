@@ -109,6 +109,65 @@ def cmd_list(args) -> int:
     return 0
 
 
+_CLUSTER_DIR = "/tmp/ray_tpu/clusters"
+
+
+def cmd_up(args) -> int:
+    """Launch a cluster from a YAML config (reference: ``ray up``,
+    `scripts.py:1238`): GCS + head raylet + autoscaler in one supervised
+    head process; workers come and go via the autoscaler."""
+    import yaml
+
+    with open(args.config) as f:
+        name = (yaml.safe_load(f) or {}).get("cluster_name", "default")
+    os.makedirs(_CLUSTER_DIR, exist_ok=True)
+    # Detach: the monitor must not hold the CLI's stdio (callers capturing
+    # this command's output would otherwise wait on the long-lived child).
+    log = open(os.path.join(_CLUSTER_DIR, f"{name}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.autoscaler.monitor_main",
+         "--config", os.path.abspath(args.config)],
+        stdout=subprocess.PIPE, stderr=log, stdin=subprocess.DEVNULL,
+        start_new_session=True, text=True)
+    log.close()
+    address = None
+    for _ in range(600):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("CLUSTER_ADDRESS"):
+            address = line.split()[1]
+            break
+    if address is None:
+        print("cluster failed to start", file=sys.stderr)
+        return 1
+    proc.stdout.close()  # monitor keeps running detached
+    with open(os.path.join(_CLUSTER_DIR, f"{name}.json"), "w") as f:
+        json.dump({"name": name, "pid": proc.pid, "address": address}, f)
+    print(f"cluster {name!r} up at {address}")
+    print(f"connect with: ray_tpu.init(address=\"{address}\")")
+    print(f"tear down with: ray_tpu down --name {name}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    """Tear down a cluster started with ``up`` (reference: ``ray down``)."""
+    path = os.path.join(_CLUSTER_DIR, f"{args.name}.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except OSError:
+        print(f"no cluster record {args.name!r}", file=sys.stderr)
+        return 1
+    try:
+        os.kill(rec["pid"], signal.SIGTERM)
+    except ProcessLookupError:
+        pass
+    os.unlink(path)
+    print(f"cluster {args.name!r} down")
+    return 0
+
+
 def cmd_memory(args) -> int:
     """Object-store usage + object table (reference: ``ray memory``)."""
     ray_tpu = _connect(args)
@@ -208,6 +267,14 @@ def main(argv=None) -> int:
     p.add_argument("what", choices=["nodes", "actors", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("up", help="launch a cluster from YAML (ray up)")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down an up'd cluster (ray down)")
+    p.add_argument("--name", default="default")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("memory", help="object store usage (ray memory)")
     p.add_argument("--address", required=True)

@@ -104,8 +104,12 @@ class Queue:
         self.maxsize = maxsize
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
-        # each PARKED blocking caller holds one concurrency slot
-        opts.setdefault("max_concurrency", 64)
+        # Each PARKED blocking caller holds one concurrency slot until it
+        # resolves; the default matches the reference's async-actor default
+        # (1000) so realistic producer/consumer counts cannot wedge the
+        # actor's dispatch queue.  Parked coroutines are cheap (one asyncio
+        # task each).
+        opts.setdefault("max_concurrency", 1000)
         self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
             maxsize)
 

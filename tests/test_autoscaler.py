@@ -115,3 +115,61 @@ def test_autoscaler_min_workers_floor():
         assert len(cluster.worker_node_ids()) == 2
     finally:
         cluster.shutdown()
+
+
+def test_up_down_cli(tmp_path):
+    """`ray_tpu up cluster.yaml` / `down` (reference: `ray up/down`,
+    `scripts.py:1238,1314`): head + autoscaler come up from YAML,
+    min_workers materialize, tasks run, teardown reaps everything."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(
+        "cluster_name: testup\n"
+        "max_workers: 3\n"
+        "idle_timeout_s: 60\n"
+        "head_node:\n"
+        "  resources: {CPU: 1}\n"
+        "worker_node_types:\n"
+        "  cpu2:\n"
+        "    resources: {CPU: 2}\n"
+        "    min_workers: 1\n"
+        "    max_workers: 2\n"
+        "    object_store_mb: 32\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "up", str(cfg)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    address = [ln for ln in out.stdout.splitlines()
+               if "up at" in ln][0].split()[-1]
+    try:
+        ray_tpu.init(address=address)
+        # min_workers worker joins -> 3 CPUs total eventually
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 3:
+                break
+            time.sleep(0.5)
+        assert ray_tpu.cluster_resources()["CPU"] >= 3
+
+        @ray_tpu.remote(num_cpus=2)
+        def on_worker():
+            return "hi"
+
+        assert ray_tpu.get(on_worker.remote(), timeout=60) == "hi"
+        ray_tpu.shutdown()
+    finally:
+        down = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "down",
+             "--name", "testup"],
+            capture_output=True, text=True, timeout=60)
+        assert down.returncode == 0, down.stderr[-300:]

@@ -121,3 +121,22 @@ def test_metrics_tag_validation():
         c.inc(-1)
     with pytest.raises(ValueError):
         Histogram("test_bad_bounds", boundaries=[-1.0])
+
+
+def test_job_logs_endpoint(cluster, dashboard):
+    import sys
+
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(cluster.address)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('dash-log-marker')\"",
+        submission_id="job-dashlogs")
+    client.wait_until_finished(job_id, timeout=60)
+    text = _get(dashboard.url + "/api/jobs/job-dashlogs/logs")
+    assert "dash-log-marker" in text
+    # unknown job -> 404
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dashboard.url + "/api/jobs/nosuch/logs")

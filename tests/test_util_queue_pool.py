@@ -81,3 +81,42 @@ def test_actor_pool_submit_get_next(ray):
     assert not pool.has_free()
     assert pool.get_next(timeout=30) == "a"
     assert pool.has_free()
+
+
+class TestMultiprocessingPool:
+    """Drop-in multiprocessing.Pool over actors (reference:
+    `python/ray/util/multiprocessing/pool.py`)."""
+
+    def test_map_and_apply(self, ray):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as pool:
+            assert pool.map(lambda x: x * x, range(8)) == \
+                [0, 1, 4, 9, 16, 25, 36, 49]
+            assert pool.apply(lambda a, b: a + b, (3, 4)) == 7
+            assert pool.starmap(lambda a, b: a * b,
+                                [(1, 2), (3, 4)]) == [2, 12]
+
+    def test_async_and_imap(self, ray):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as pool:
+            r = pool.apply_async(lambda x: x + 1, (41,))
+            assert r.get(timeout=30) == 42
+            assert r.successful()
+            m = pool.map_async(lambda x: -x, range(4))
+            assert m.get(timeout=30) == [0, -1, -2, -3]
+            assert list(pool.imap(lambda x: x * 10, range(4),
+                                  chunksize=2)) == [0, 10, 20, 30]
+            assert sorted(pool.imap_unordered(
+                lambda x: x * 10, range(4), chunksize=1)) == [0, 10, 20, 30]
+
+    def test_closed_pool_rejects(self, ray):
+        from ray_tpu.util.multiprocessing import Pool
+
+        pool = Pool(processes=1)
+        pool.close()
+        with pytest.raises(ValueError):
+            pool.apply(lambda: 1)
+        pool.join()
+        pool.terminate()
