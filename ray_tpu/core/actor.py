@@ -50,9 +50,12 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: ActorID, class_name: str = "Actor"):
+    def __init__(self, actor_id: ActorID, class_name: str = "Actor",
+                 method_groups: Optional[Dict[str, str]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
+        # method -> concurrency group (actors with named groups only)
+        self._method_groups = method_groups or {}
 
     @property
     def actor_id(self) -> ActorID:
@@ -76,6 +79,8 @@ class ActorHandle:
             num_returns=num_returns,
             actor_id=self._actor_id,
             method_name=method_name,
+            concurrency_group=(opts.get("concurrency_group")
+                               or self._method_groups.get(method_name)),
         )
         from ray_tpu.util.tracing import submit_with_span
 
@@ -96,7 +101,8 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._class_name))
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_groups))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -130,6 +136,38 @@ class ActorClass:
         actor_id = ActorID.from_random()
         max_restarts = opts.get("max_restarts",
                                 config.actor_max_restarts_default)
+        groups = opts.get("concurrency_groups")
+        declared_conc = opts.get("max_concurrency", 1)
+        method_groups: Optional[Dict[str, str]] = None
+        if groups:
+            if "_default" in groups:
+                raise ValueError(
+                    "'_default' is reserved; set its size via "
+                    "max_concurrency")
+            for gname, n in groups.items():
+                if not isinstance(n, int) or n < 1:
+                    raise ValueError(
+                        f"concurrency group {gname!r} size must be a "
+                        f"positive int, got {n!r}")
+            # method -> group map from @ray_tpu.method tags, shipped on the
+            # creation spec so the raylet can admit per group and any
+            # handle (incl. get_actor) can stamp calls.
+            method_groups = {}
+            for mname, attr in vars(self._cls).items():
+                tag = getattr(attr, "__ray_tpu_method_options__", None)
+                if tag and tag.get("concurrency_group"):
+                    g = tag["concurrency_group"]
+                    if g not in groups:
+                        raise ValueError(
+                            f"method {mname!r} tagged with undeclared "
+                            f"concurrency group {g!r}")
+                    method_groups[mname] = g
+            concurrency_groups = {"_default": declared_conc, **groups}
+            # raylet total admission cap = sum of per-group slots
+            total_concurrency = declared_conc + sum(groups.values())
+        else:
+            concurrency_groups = None
+            total_concurrency = declared_conc
         placement = _placement_from_opts(opts) or {}
         if opts.get("name"):
             placement["name"] = opts["name"]
@@ -145,13 +183,15 @@ class ActorClass:
             num_returns=1,
             resources=_build_resources(opts),
             max_restarts=max_restarts,
-            max_concurrency=opts.get("max_concurrency", 1),
+            max_concurrency=total_concurrency,
+            concurrency_groups=concurrency_groups,
+            method_groups=method_groups,
             actor_id=actor_id,
             runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=placement or None,
         )
         worker.submit_spec(spec)
-        return ActorHandle(actor_id, self.__name__)
+        return ActorHandle(actor_id, self.__name__, method_groups)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -182,7 +222,8 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
     else:
         info = worker._request("named_actor", name=name, namespace=namespace)
         aid, creation_spec = info["actor_id"], info["creation_spec"]
-    return ActorHandle(aid, creation_spec.name.split(".")[0])
+    return ActorHandle(aid, creation_spec.name.split(".")[0],
+                       getattr(creation_spec, "method_groups", None))
 
 
 def kill(actor: ActorHandle, no_restart: bool = True):

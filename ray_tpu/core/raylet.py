@@ -199,6 +199,11 @@ class _ActorState:
         # actor scheduling queues + concurrency groups).
         self.inflight: Dict[TaskID, TaskSpec] = {}
         self.max_concurrency = max(1, spec.max_concurrency)
+        # Named concurrency groups: per-group admission limits so a
+        # saturated group never starves another (reference: independent
+        # group scheduling queues, `concurrency_group_manager.cc`).
+        self.group_limits: Optional[Dict[str, int]] = \
+            getattr(spec, "concurrency_groups", None)
         self.restarts_left = spec.max_restarts
         self.death_reason = ""
 
@@ -2394,10 +2399,31 @@ class Raylet:
                     actor.queue.appendleft(spec)
                     return
             return
+        def group_of(s: TaskSpec) -> str:
+            return getattr(s, "concurrency_group", None) or "_default"
+
+        def group_has_room(s: TaskSpec) -> bool:
+            if actor.group_limits is None:
+                return True
+            g = group_of(s)
+            limit = actor.group_limits.get(g,
+                                           actor.group_limits["_default"])
+            used = sum(1 for f in actor.inflight.values()
+                       if group_of(f) == g)
+            return used < limit
+
+        # Scan instead of strict FIFO when groups are declared: a task
+        # whose group is saturated is skipped so OTHER groups keep flowing
+        # (FIFO is preserved WITHIN each group — skipped specs keep their
+        # relative order in the deferred queue).
+        deferred_groups: deque = deque()
         while (actor.state == "alive" and actor.conn is not None
                and actor.queue and len(actor.inflight) < actor.max_concurrency):
             spec = actor.queue.popleft()
             if self._dep_errored(spec):
+                continue
+            if not group_has_room(spec):
+                deferred_groups.append(spec)
                 continue
             if self.cluster_mode and self._remote_deps_pending(spec):
                 # A store arg lives on another node: keep FIFO order, park
@@ -2428,6 +2454,9 @@ class Raylet:
             self._record_event(spec, "RUNNING", pid=conn.pid)
             conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
                        "fn_blob": None})
+        # put group-saturated specs back at the FRONT, preserving order
+        while deferred_groups:
+            actor.queue.appendleft(deferred_groups.pop())
 
     # --------------------------------------------------------------- actors
 

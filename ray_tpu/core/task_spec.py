@@ -46,6 +46,15 @@ class TaskSpec:
     method_name: Optional[str] = None
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Named concurrency groups (reference: concurrency_group_manager.cc):
+    # creation task carries {"_default": n, "io": 2, ...} — the raylet
+    # gates on the SUM; the worker enforces per-group limits with one
+    # thread pool per group.  Actor tasks carry their target group.
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: Optional[str] = None
+    # method -> group map (creation task; lets get_actor handles stamp
+    # tagged methods' calls with their group)
+    method_groups: Optional[Dict[str, str]] = None
     # Runtime env (env_vars, working_dir) — per-task override
     runtime_env: Optional[dict] = None
     # Placement: pg id hex + bundle index, or node-affinity

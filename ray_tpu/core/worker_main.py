@@ -49,6 +49,7 @@ class RemoteWorker(Worker):
         # Actor concurrency (reference: threaded concurrency groups + asyncio
         # actors, `src/ray/core_worker/transport/concurrency_group_manager.cc`)
         self.actor_executor: Optional[ThreadPoolExecutor] = None
+        self.group_executors: Optional[Dict[str, ThreadPoolExecutor]] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
         self._rid = 0
         self._rid_lock = threading.Lock()
@@ -202,7 +203,22 @@ def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):
         threading.Thread(target=loop.run_forever, daemon=True,
                          name="actor-asyncio").start()
         worker.actor_loop = loop
-    if spec.max_concurrency > 1 and worker.actor_executor is None:
+    if spec.concurrency_groups and worker.group_executors is None:
+        if has_async:
+            raise NotImplementedError(
+                "concurrency_groups are thread-pool based and do not "
+                "combine with asyncio actor methods — use one or the "
+                "other (reference async fiber groups are not implemented)")
+        # One thread pool per named group (reference: threaded concurrency
+        # groups, `concurrency_group_manager.cc`): each group's limit is
+        # enforced by its pool size; the raylet additionally admits per
+        # group.
+        worker.group_executors = {
+            name: ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix=f"actor-{name}")
+            for name, n in spec.concurrency_groups.items()
+        }
+    elif spec.max_concurrency > 1 and worker.actor_executor is None:
         worker.actor_executor = ThreadPoolExecutor(
             max_workers=spec.max_concurrency, thread_name_prefix="actor-exec"
         )
@@ -282,6 +298,10 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
 
     _ctx_token = _current_task_id.set(spec.task_id)
     try:
+        if msg.get("__bad_group__") is not None:
+            raise ValueError(
+                f"undeclared concurrency group "
+                f"{msg['__bad_group__']!r} for {spec.name}")
         _apply_runtime_env(spec)
         args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
         if spec.kind == ACTOR_CREATION_TASK:
@@ -411,6 +431,19 @@ def main():
                 asyncio.run_coroutine_threadsafe(
                     _execute_async(worker, msg), worker.actor_loop
                 )
+                continue
+            if worker.group_executors is not None:
+                group = spec.concurrency_group
+                if group is None and method is not None:
+                    group = getattr(method, "__ray_tpu_method_options__",
+                                    {}).get("concurrency_group")
+                pool = worker.group_executors.get(group or "_default")
+                if pool is None:
+                    # undeclared group name: fail the CALL loudly (typos
+                    # must not silently serialize onto the default pool)
+                    msg["__bad_group__"] = group
+                    pool = worker.group_executors["_default"]
+                pool.submit(execute_task, worker, msg)
                 continue
             if worker.actor_executor is not None:
                 worker.actor_executor.submit(execute_task, worker, msg)

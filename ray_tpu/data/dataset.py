@@ -839,6 +839,26 @@ class Dataset:
             out_metas.append(m)
         return Dataset.from_block_refs(out_refs, ray_tpu.get(out_metas))
 
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) row split (reference: ``Dataset.train_test_split``).
+        ``test_size`` is a fraction in (0, 1)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        ds = ds.materialize()
+        total = ds.count()
+        n_test = max(1, int(total * test_size))
+        if total < 2 or n_test >= total:
+            raise ValueError(
+                f"cannot split {total} row(s) with test_size={test_size} "
+                "(both splits must be non-empty)")
+        parts = ds._repartition_by_sizes([total - n_test, n_test])
+        return (Dataset([parts._sources[0]], metas=[parts._metas[0]]),
+                Dataset([parts._sources[1]], metas=[parts._metas[1]]))
+
     def union(self, *others: "Dataset") -> "Dataset":
         ds = [self.materialize()] + [o.materialize() for o in others]
         return Dataset([s for d in ds for s in d._sources],

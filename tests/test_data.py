@@ -373,3 +373,19 @@ def test_write_parquet_csv_json_roundtrip(ray, tmp_path):
     files = ds.write_json(str(tmp_path / "js"))
     back = rd.read_json(str(tmp_path / "js")).take_all()
     assert sorted(int(r["a"]) for r in back) == list(range(7))
+
+
+def test_train_test_split(ray):
+    ds = rd.range(100)
+    train, test = ds.train_test_split(0.2)
+    assert train.count() == 80 and test.count() == 20
+    # shuffled split covers all rows exactly once
+    train_s, test_s = rd.range(50).train_test_split(
+        0.3, shuffle=True, seed=0)
+    def vals(ds):
+        return [int(r["id"]) if isinstance(r, dict) else int(r)
+                for r in ds.take_all()]
+
+    assert sorted(vals(train_s) + vals(test_s)) == list(range(50))
+    with pytest.raises(ValueError):
+        ds.train_test_split(1.5)
