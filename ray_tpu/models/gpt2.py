@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import flash_attention_bshd
 
 
 @dataclass(frozen=True)
@@ -126,24 +126,30 @@ def _attention(x, p, cfg: GPT2Config, mesh=None):
     H, D = cfg.n_head, cfg.head_dim
     qkv = x @ p["c_attn"]["kernel"].astype(x.dtype) + p["c_attn"]["bias"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-    v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+    q = q.reshape(B, S, H, D)
+    k = k.reshape(B, S, H, D)
+    v = v.reshape(B, S, H, D)
     if cfg.attention in ("ring", "ulysses"):
         # sequence parallelism: shard_map over the bound mesh's sp axis
+        # (head-major layout — the ring rotates (B, H, Sq, D) chunks)
         from ray_tpu.parallel.context import require_mesh
         from ray_tpu.parallel.ring_attention import ring_attention_sharded
 
-        o = ring_attention_sharded(q, k, v, require_mesh(), causal=True,
-                                   variant=cfg.attention)
+        o = ring_attention_sharded(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), require_mesh(), causal=True,
+            variant=cfg.attention).transpose(0, 2, 1, 3)
     elif cfg.attention == "dense":
         from ray_tpu.ops.flash_attention import _reference_attention
 
-        o, _ = _reference_attention(q, k, v, D ** -0.5, True)
-        o = o.astype(x.dtype)
+        o, _ = _reference_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), D ** -0.5, True)
+        o = o.astype(x.dtype).transpose(0, 2, 1, 3)
     else:
-        o = flash_attention(q, k, v, True)
-    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+        # layout-native kernel: no (B,S,H,D) <-> (B,H,S,D) transposes
+        o = flash_attention_bshd(q, k, v, True)
+    o = o.reshape(B, S, E)
     return o @ p["c_proj"]["kernel"].astype(x.dtype) + p["c_proj"]["bias"].astype(x.dtype)
 
 

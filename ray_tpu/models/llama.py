@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import flash_attention_bshd
 
 
 @dataclass(frozen=True)
@@ -130,9 +130,10 @@ def _attn_block(x, p, cfg: LlamaConfig, positions, cache=None,
     else:
         k = _repeat_kv(k, H // Hk)
         v = _repeat_kv(v, H // Hk)
-        o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                            v.transpose(0, 2, 1, 3), True)
-        o = o.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        # layout-native lane kernel (128-dim heads map 1:1 onto lane
+        # blocks): no (B,S,H,D) <-> (B,H,S,D) transposes
+        o = flash_attention_bshd(q, k, v, True)
+        o = o.reshape(B, S, H * D)
     return o @ p["o_proj"]["kernel"].astype(x.dtype), new_cache
 
 

@@ -1,4 +1,4 @@
-"""Flash attention — Pallas TPU kernel with online softmax.
+"""Flash attention — Pallas TPU kernels with online softmax.
 
 The hot op of the transformer stack, built TPU-first (MXU-sized tiles,
 VMEM-resident accumulators, bf16 in / f32 accumulate).  Replaces what the
@@ -6,14 +6,31 @@ reference delegates to torch/CUDA (scaled_dot_product_attention inside user
 train loops); here it is a framework op reused by models, ring attention
 (`ray_tpu/parallel/ring_attention.py`) and serving.
 
-Forward: pallas kernel, grid (batch*heads, q_blocks), inner fori over k
-blocks with running (max, sum, acc).  Causal variant stops the inner loop at
-the diagonal block.  Backward: TWO pallas kernels (FlashAttention-2 split):
-a dq kernel blocked over q rows and a dk/dv kernel blocked over k columns,
-both recomputing probabilities tile-by-tile from the saved logsumexp — the
-S×S matrix never exists in HBM in either pass.
+Two entry points / layouts:
 
-On non-TPU backends the same kernel runs in interpret mode for tiny shapes
+* ``flash_attention`` — (batch, heads, seq, head_dim).  Forward: grid
+  (batch*heads, q_blocks), inner fori over k blocks with running
+  (max, sum, acc); causal variant skips blocks past the diagonal.
+* ``flash_attention_bshd`` — (batch, seq, heads, head_dim), the layout
+  models naturally produce from the fused qkv projection.  The arrays are
+  viewed as (batch, seq, heads*head_dim) and the kernels take 128-wide
+  *lane* blocks (one 128-dim head, or a pair of 64-dim heads, per block;
+  Pallas TPU requires minor block dims of 128), slicing each head out of
+  the lanes in-kernel.  No (B,S,H,D) <-> (B,H,S,D) transpose ever
+  materializes — the bhsd route costs four such transposes per transformer
+  layer fwd (plus their mirrors in bwd), ~400 MB of HBM round trips per
+  GPT-2-small layer per step.
+
+Backward: when a whole (b, h) slice fits one block (block == S — the
+transformer bench regime), ONE fused kernel computes dq/dk/dv per grid
+step, sharing the recomputed s and dp tiles (5 (S,S)-operand dots instead
+of the 7 a two-kernel FlashAttention-2 split pays; measured ~6% end-to-end
+on the GPT-2 bench).  Otherwise the classic two-kernel split runs: a dq
+kernel blocked over q rows and a dk/dv kernel blocked over k columns, both
+recomputing probabilities tile-by-tile from the saved logsumexp.  The S×S
+matrix never exists in HBM in any pass.
+
+On non-TPU backends the same kernels run in interpret mode for tiny shapes
 (tests), and a pure-XLA reference path is used otherwise.
 """
 
@@ -36,6 +53,7 @@ except ImportError:  # pragma: no cover
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # 1/ln(2)
+_LANE = 128  # minor-dim block width Pallas TPU requires
 
 # Both grid dims are embarrassingly parallel (batch*heads, and q/k blocks
 # within a head); telling Mosaic so lets it pipeline block prologues across
@@ -47,32 +65,35 @@ else:  # pragma: no cover
     _COMPILER_PARAMS = None
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_q, block_k, seq_len):
-    """Attention at small head_dim is VPU-bound (the per-score softmax ops
-    outnumber usable MXU work ~10:1 on v5e), so the kernel is organized to
-    minimize VPU ops per score element:
+# ---------------------------------------------------------------------------
+# shared kernel cores (operate on squeezed (rows, d) tiles)
+# ---------------------------------------------------------------------------
+
+def _fwd_core(q, read_k, read_v, qi, *, causal, block_q, block_k, seq_len):
+    """Online-softmax forward over one q tile.
+
+    Attention at small head_dim is bound by (S, S)-operand dot throughput,
+    not FLOPs (a (1024,64)x(64,1024) dot runs at ~1/10 the rate of a square
+    one on v5e), so the body minimizes VPU ops per score element:
 
       * dots are bf16-in / f32-accumulate — never cast operands to f32
         (that demotes the MXU to its multi-pass f32 path);
-      * sm_scale is folded into the q tile once (d ops/row, not bk);
-      * the causal mask (iota+compare+select) runs ONLY on the diagonal
-        block — interior blocks take the unmasked body;
-      * exp runs on bf16 lanes (2x VPU width; p is consumed as bf16 by
+      * sm_scale*log2(e) is pre-folded into the q tile by the caller
+        (d ops/row, not bk) and the whole softmax runs in base-2 units;
+      * the causal mask (iota+compare+select) runs ONLY on blocks
+        intersecting the diagonal — interior blocks take the unmasked body;
+      * exp2 runs on bf16 lanes (2x VPU width; p is consumed as bf16 by
         the p@v dot anyway, and max-subtraction bounds the error).
-    """
-    qi = pl.program_id(1)
-    # base-2 online softmax: s, m, and the exp2 args are all in log2 units
-    # (sm_scale * log2(e) folded into q once); exp2 is one VPU op where
-    # exp costs an extra multiply per element.
-    q = q_ref[0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq, d)
+
+    q: (block_q, d) with scale folded, base-2 units.  read_k/read_v:
+    kj -> (block_k, d).  Returns (acc f32 (block_q, d), m, l)."""
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
 
     def body(kj, carry, masked):
         acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(kj * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kj * block_k, block_k), :]
+        k = read_k(kj)
+        v = read_v(kj)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -96,7 +117,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         )
         return acc, m_new, l_new
 
-    d = q_ref.shape[-1]
+    d = q.shape[-1]
     init = (
         jnp.zeros((block_q, d), jnp.float32),
         jnp.full((block_q, 1), _NEG_INF, jnp.float32),
@@ -115,43 +136,70 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     else:
         acc, m, l = jax.lax.fori_loop(
             0, num_k_blocks, lambda kj, c: body(kj, c, False), init)
+    return acc, m, l
+
+
+def _finish_fwd(acc, m, l, out_dtype):
+    """(o tile, lse tile in natural-log units) from the fwd carry."""
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse returned in NATURAL log units (vjp/ring-attention contract):
-    # m is base-2, so m*ln2 + log(l).  Per-row only.
-    lse_ref[0] = m * jnp.asarray(1.0 / _LOG2E, m.dtype) + jnp.log(l_safe)
+    o = (acc / l_safe).astype(out_dtype)
+    lse = m * jnp.asarray(1.0 / _LOG2E, m.dtype) + jnp.log(l_safe)
+    return o, lse
 
 
-def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    B, H, S, D = q.shape
-    qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-    grid = (B * H, S // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=S,
-    )
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
-        ],
-        interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
-    )(qf, kf, vf)
-    return o.reshape(B, H, S, D), lse.reshape(B, H, S)
+def _bwd_fused_core(q, k, v, do, lse, delta, *, sm_scale, causal, seq_len):
+    """Whole-(b,h)-slice backward: recompute s and dp ONCE, contract into
+    dq, dk, dv — 5 (S,S)-operand dots vs the split's 7.  q arrives with
+    sm_scale*log2e folded (base-2 units); lse is base-2; delta f32 (S, 1).
+    Returns (dq, dk, dv) in q's dtype."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)   # (S, S) f32
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp2((s - lse).astype(k.dtype))   # (S, S) bf16; masked -> 0
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)   # (S, S) f32
+    ds = p * (dp - delta).astype(k.dtype)     # (S, S) bf16
+    dq = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    # q carries sm_scale*log2e; rescale dk back by ln2.
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * (1.0 / _LOG2E)
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+# ---------------------------------------------------------------------------
+# bhsd layout: arrays viewed (B*H, S, D), one head per grid step
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[...] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)
+    acc, m, l = _fwd_core(
+        q, lambda kj: k_ref[pl.ds(kj * block_k, block_k), :],
+        lambda kj: v_ref[pl.ds(kj * block_k, block_k), :], qi,
+        causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len)
+    o_ref[...], lse_ref[...] = _finish_fwd(acc, m, l, o_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
+    q = q_ref[...] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)
+    dq, dk, dv = _bwd_fused_core(
+        q, k_ref[...], v_ref[...], do_ref[...],
+        lse_ref[...] * _LOG2E, delta_ref[...],
+        sm_scale=sm_scale, causal=causal, seq_len=seq_len)
+    dq_ref[...], dk_ref[...], dv_ref[...] = dq, dk, dv
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -160,16 +208,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     # sm_scale * log2(e) folded into the q tile: s = (q*sc*log2e)@k is in
     # base-2 units so p = exp2(s - lse*log2e); the trailing *sc of ds is
     # hoisted onto the dq tile at the end (d ops/row, not bk).
-    q = q_ref[0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq, d)
-    do = do_ref[0]                            # (bq, d) bf16
-    lse = lse_ref[0] * _LOG2E                 # (bq, 1) f32, base-2 units
-    delta = delta_ref[0]                      # (bq, 1) f32
+    q = q_ref[...] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq, d)
+    do = do_ref[...]                          # (bq, d) bf16
+    lse = lse_ref[...] * _LOG2E               # (bq, 1) f32, base-2 units
+    delta = delta_ref[...]                    # (bq, 1) f32
 
     num_k_blocks = pl.cdiv(seq_len, block_k)
 
     def body(kj, acc, masked):
-        k = k_ref[0, pl.ds(kj * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kj * block_k, block_k), :]
+        k = k_ref[pl.ds(kj * block_k, block_k), :]
+        v = v_ref[pl.ds(kj * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -206,15 +254,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     else:
         acc = jax.lax.fori_loop(0, num_k_blocks,
                                 lambda kj, a: body(kj, a, False), init)
-    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+    dq_ref[...] = (acc * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
                     seq_len):
     kj = pl.program_id(1)
-    k = k_ref[0]                              # (bk, d) bf16
-    v = v_ref[0]                              # (bk, d) bf16
+    k = k_ref[...]                            # (bk, d) bf16
+    v = v_ref[...]                            # (bk, d) bf16
     # q carries sm_scale*log2e (base-2 units for exp2); it also serves as
     # the dk contraction operand, so dk is rescaled by 1/log2e at the end.
     scale = jnp.asarray(sm_scale * _LOG2E, k.dtype)
@@ -228,10 +276,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         # scale folded into the q tile (serves both the s recompute and
         # the dk dot, absorbing ds's trailing *sm_scale)
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :] * scale
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :] * _LOG2E
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # (bq, 1)
+        q = q_ref[pl.ds(qi * block_q, block_q), :] * scale
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[pl.ds(qi * block_q, block_q), :] * _LOG2E
+        delta = delta_ref[pl.ds(qi * block_q, block_q), :]  # (bq, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -274,8 +322,36 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         dk_acc, dv_acc = jax.lax.fori_loop(
             0, num_q_blocks, lambda qi, c: body(qi, c, False), init)
-    dk_ref[0] = (dk_acc * (1.0 / _LOG2E)).astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    dk_ref[...] = (dk_acc * (1.0 / _LOG2E)).astype(dk_ref.dtype)
+    dv_ref[...] = dv_acc.astype(dv_ref.dtype)
+
+
+def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, S // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=S,
+    )
+    qspec = pl.BlockSpec((None, block_q, D), lambda g, i: (g, i, 0))
+    kvspec = pl.BlockSpec((None, S, D), lambda g, i: (g, 0, 0))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((None, block_q, 1), lambda g, i: (g, i, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(qf, kf, vf)
+    return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
 def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
@@ -291,44 +367,51 @@ def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).reshape(B * H, S, 1)
 
+    if block_q == block_k == S:
+        # fused single-pass backward: shares s/dp across dq/dk/dv.
+        spec = pl.BlockSpec((None, S, D), lambda g, i: (g, 0, 0))
+        row = pl.BlockSpec((None, S, 1), lambda g, i: (g, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
+                              causal=causal, seq_len=S),
+            grid=(B * H, 1),
+            in_specs=[spec, spec, spec, spec, row, row],
+            out_specs=[spec, spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                       jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                       jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+            interpret=interpret,
+            compiler_params=_COMPILER_PARAMS,
+        )(qf, kf, vf, dof, lsef, delta)
+        return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+                dv.reshape(B, H, S, D))
+
+    qspec = pl.BlockSpec((None, block_q, D), lambda g, i: (g, i, 0))
+    qrow = pl.BlockSpec((None, block_q, 1), lambda g, i: (g, i, 0))
+    full = pl.BlockSpec((None, S, D), lambda g, i: (g, 0, 0))
+    fullrow = pl.BlockSpec((None, S, 1), lambda g, i: (g, 0, 0))
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, seq_len=S,
         ),
         grid=(B * H, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        in_specs=[qspec, full, full, qspec, qrow, qrow],
+        out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
     )(qf, kf, vf, dof, lsef, delta)
 
+    kspec = pl.BlockSpec((None, block_k, D), lambda g, i: (g, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, seq_len=S,
         ),
         grid=(B * H, S // block_k),
-        in_specs=[
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-        ],
+        in_specs=[full, kspec, kspec, full, fullrow, fullrow],
+        out_specs=[kspec, kspec],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
@@ -340,6 +423,138 @@ def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
             dv.reshape(B, H, S, D))
 
+
+# ---------------------------------------------------------------------------
+# bshd layout: arrays viewed (B, S, H*D), 128-wide lane blocks, heads
+# sliced from lanes in-kernel — no transposes anywhere
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_lanes(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                      causal, heads_per_block, head_dim, block_q, block_k,
+                      seq_len):
+    """Refs: q/o (block_q, hpb*head_dim), k/v (S, hpb*head_dim), lse
+    (block_q, hpb).  Each 128-lane block carries hpb heads side by side;
+    the per-head chains run sequentially so their (S, S) temporaries
+    reuse the same VMEM."""
+    qi = pl.program_id(1)
+    for h in range(heads_per_block):
+        sl = pl.ds(h * head_dim, head_dim)
+        q = q_ref[:, sl] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)
+        acc, m, l = _fwd_core(
+            q, lambda kj: k_ref[pl.ds(kj * block_k, block_k), sl],
+            lambda kj: v_ref[pl.ds(kj * block_k, block_k), sl], qi,
+            causal=causal, block_q=block_q, block_k=block_k, seq_len=seq_len)
+        o, lse = _finish_fwd(acc, m, l, o_ref.dtype)
+        o_ref[:, sl] = o
+        lse_ref[:, h] = lse[:, 0]
+
+
+def _bwd_fused_kernel_lanes(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                            heads_per_block, head_dim, seq_len):
+    for h in range(heads_per_block):
+        sl = pl.ds(h * head_dim, head_dim)
+        q = q_ref[:, sl] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)
+        dq, dk, dv = _bwd_fused_core(
+            q, k_ref[:, sl], v_ref[:, sl], do_ref[:, sl],
+            lse_ref[:, h][:, None] * _LOG2E, delta_ref[:, h][:, None],
+            sm_scale=sm_scale, causal=causal, seq_len=seq_len)
+        dq_ref[:, sl] = dq
+        dk_ref[:, sl] = dk
+        dv_ref[:, sl] = dv
+
+
+def _lanes_config(H, D):
+    """heads_per_block so each lane block is exactly _LANE wide (the Pallas
+    TPU minor-dim constraint); None when the layout can't tile that way."""
+    if D > _LANE and D % _LANE == 0:
+        # wide heads: block covers part of one head?  Not supported — the
+        # in-kernel slice would split a head across blocks.
+        return None
+    if _LANE % D:
+        return None
+    hpb = _LANE // D
+    if H % hpb:
+        return None
+    return hpb
+
+
+def _pallas_forward_bshd(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret):
+    B, S, H, D = q.shape
+    hpb = _lanes_config(H, D)
+    qf = q.reshape(B, S, H * D)
+    kf = k.reshape(B, S, H * D)
+    vf = v.reshape(B, S, H * D)
+    G = H // hpb                      # lane-block groups per batch entry
+    W = hpb * D                       # == _LANE
+    grid = (B * G, S // block_q)
+    kernel = functools.partial(
+        _fwd_kernel_lanes, sm_scale=sm_scale, causal=causal,
+        heads_per_block=hpb, head_dim=D, block_q=block_q, block_k=block_k,
+        seq_len=S,
+    )
+    qspec = pl.BlockSpec((None, block_q, W), lambda g, i: (g // G, i, g % G))
+    kvspec = pl.BlockSpec((None, S, W), lambda g, i: (g // G, 0, g % G))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((None, block_q, hpb),
+                                lambda g, i: (g, i, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * D), q.dtype),
+            jax.ShapeDtypeStruct((B * G, S, hpb), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(qf, kf, vf)
+    # lse (B*G, S, hpb) -> (B, H, S): group-major heads, tiny tensor.
+    lse = lse.reshape(B, G, S, hpb).transpose(0, 1, 3, 2).reshape(B, H, S)
+    return o.reshape(B, S, H, D), lse
+
+
+def _pallas_backward_bshd(q, k, v, o, lse, do, sm_scale, causal, interpret):
+    """Fused whole-S backward in the lane layout (requires S as the only
+    block — callers gate on that)."""
+    B, S, H, D = q.shape
+    hpb = _lanes_config(H, D)
+    G = H // hpb
+    W = hpb * D
+    qf = q.reshape(B, S, H * D)
+    kf = k.reshape(B, S, H * D)
+    vf = v.reshape(B, S, H * D)
+    dof = do.reshape(B, S, H * D)
+    # lse (B, H, S) -> (B*G, S, hpb); delta likewise (tiny tensors).
+    lsef = lse.reshape(B, G, hpb, S).transpose(0, 1, 3, 2).reshape(
+        B * G, S, hpb)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(B, S, G, hpb).transpose(0, 2, 1, 3).reshape(
+        B * G, S, hpb)
+
+    spec = pl.BlockSpec((None, S, W), lambda g, i: (g // G, 0, g % G))
+    row = pl.BlockSpec((None, S, hpb), lambda g, i: (g, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel_lanes, sm_scale=sm_scale,
+                          causal=causal, heads_per_block=hpb, head_dim=D,
+                          seq_len=S),
+        grid=(B * G, 1),
+        in_specs=[spec, spec, spec, spec, row, row],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((B, S, H * D), q.dtype),
+                   jax.ShapeDtypeStruct((B, S, H * D), k.dtype),
+                   jax.ShapeDtypeStruct((B, S, H * D), v.dtype)],
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(qf, kf, vf, dof, lsef, delta)
+    return (dq.reshape(B, S, H, D), dk.reshape(B, S, H, D),
+            dv.reshape(B, S, H, D))
+
+
+# ---------------------------------------------------------------------------
+# reference path + public API
+# ---------------------------------------------------------------------------
 
 def _reference_attention(q, k, v, sm_scale, causal):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -354,11 +569,10 @@ def _reference_attention(q, k, v, sm_scale, causal):
     return o.astype(q.dtype), lse
 
 
-def _use_pallas(q, block_q, block_k) -> Optional[bool]:
+def _use_pallas(q, S, block_q, block_k) -> Optional[bool]:
     """None = no pallas at all; True = compiled; False = interpret mode."""
     if not _HAS_PLTPU:
         return None
-    S = q.shape[2]
     if S % block_q or S % block_k:
         return None
     # Degenerate blocks (odd/prime S drives _auto_block toward 1): the
@@ -374,21 +588,6 @@ def _use_pallas(q, block_q, block_k) -> Optional[bool]:
     return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=None, block_k=None):
-    """Multi-head attention over (batch, heads, seq, head_dim) tensors.
-
-    Default blocks are large ((1024, 1024)-capped) and the grid dims are
-    marked parallel for Mosaic: the kernel is VPU- not VMEM-bound at
-    transformer head dims, so fewer/bigger grid steps win (1024x1024 with
-    parallel dimension_semantics measured 1.45x over the prior 1024x512
-    arbitrary-semantics config on v5e at S=1024).
-    """
-    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o
-
-
 def _auto_block(S: int, cap: int) -> int:
     """Largest block <= cap that divides S (so the Pallas path stays
     active for any S with a power-of-two-ish factor, not just S % cap == 0
@@ -399,12 +598,26 @@ def _auto_block(S: int, cap: int) -> int:
     return max(b, 1)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=None, block_k=None):
+    """Multi-head attention over (batch, heads, seq, head_dim) tensors.
+
+    Default blocks are large ((1024, 1024)-capped) and the grid dims are
+    marked parallel for Mosaic: the kernel is bound by (S, S)-operand dot
+    throughput, not VMEM, at transformer head dims, so fewer/bigger grid
+    steps win — and whole-S blocks additionally enable the fused one-pass
+    backward (5 big dots instead of 7)."""
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
     bq = min(block_q, S) if block_q else _auto_block(S, 1024)
     bk = min(block_k, S) if block_k else _auto_block(S, 1024)
-    mode = _use_pallas(q, bq, bk)
+    mode = _use_pallas(q, S, bq, bk)
     if mode is None:
         o, lse = _reference_attention(q, k, v, scale, causal)
     else:
@@ -419,7 +632,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     S = q.shape[2]
     bq = min(block_q, S) if block_q else _auto_block(S, 1024)
     bk = min(block_k, S) if block_k else _auto_block(S, 1024)
-    mode = _use_pallas(q, bq, bk)
+    mode = _use_pallas(q, S, bq, bk)
     if mode is not None:
         return _pallas_backward(q, k, v, o, lse, do, scale, causal, bq, bk,
                                 interpret=not mode)
@@ -445,10 +658,76 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+# VMEM budget gate for the fused lane backward: its per-head temporaries
+# (s f32 + dp f32 + p/ds bf16 at (S, S)) must fit the ~16 MB scoped VMEM.
+_LANES_MAX_SEQ = 1024
+
+
+def _bshd_lanes_ok(q, S, bq, bk):
+    B, _, H, D = q.shape
+    return (_lanes_config(H, D) is not None and S % 128 == 0
+            and S % bq == 0 and S % bk == 0)
+
+
+def _bshd_lanes_bwd_ok(q, S):
+    # the fused lane backward always runs whole-S blocks (one grid step per
+    # lane group) — gate on the (S, S) temporaries fitting scoped VMEM.
+    return _bshd_lanes_ok(q, S, S, S) and S <= _LANES_MAX_SEQ
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_bshd(q, k, v, causal=False, sm_scale=None,
+                         block_q=None, block_k=None):
+    """Multi-head attention over (batch, seq, heads, head_dim) tensors —
+    the layout models naturally produce from the fused qkv projection.
+
+    When the lane tiling applies (head_dim divides 128, whole-S blocks,
+    S <= 1024) the kernels index heads through 128-wide lane blocks and no
+    (B,S,H,D) <-> (B,H,S,D) transpose ever materializes; otherwise the
+    call transposes to the bhsd kernels (still flash, just with the
+    transpose cost the lane path avoids)."""
+    o, _ = _flash_fwd_bshd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd_bshd(q, k, v, causal, sm_scale, block_q, block_k):
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    S = q.shape[1]
+    bq = min(block_q, S) if block_q else _auto_block(S, 1024)
+    bk = min(block_k, S) if block_k else _auto_block(S, 1024)
+    mode = _use_pallas(q, S, bq, bk)
+    if mode is not None and _bshd_lanes_ok(q, S, bq, bk):
+        o, lse = _pallas_forward_bshd(q, k, v, scale, causal, bq, bk,
+                                      interpret=not mode)
+        return o, (q, k, v, o, lse)
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    o, (_, _, _, ot, lse) = _flash_fwd(tr(q), tr(k), tr(v), causal, sm_scale,
+                                       block_q, block_k)
+    return tr(o), (q, k, v, tr(ot), lse)
+
+
+def _flash_bwd_bshd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    S = q.shape[1]
+    bq = min(block_q, S) if block_q else _auto_block(S, 1024)
+    bk = min(block_k, S) if block_k else _auto_block(S, 1024)
+    mode = _use_pallas(q, S, bq, bk)
+    if mode is not None and _bshd_lanes_bwd_ok(q, S):
+        return _pallas_backward_bshd(q, k, v, o, lse, do, scale, causal,
+                                     interpret=not mode)
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    dq, dk, dv = _flash_bwd(causal, sm_scale, block_q, block_k,
+                            (tr(q), tr(k), tr(v), tr(o), lse), tr(do))
+    return tr(dq), tr(dk), tr(dv)
+
+
+flash_attention_bshd.defvjp(_flash_fwd_bshd, _flash_bwd_bshd)
+
+
 def mha(q, k, v, causal=False, sm_scale=None):
-    """Attention over (batch, seq, heads, head_dim) layout (model-friendly)."""
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    o = flash_attention(qt, kt, vt, causal, sm_scale)
-    return o.transpose(0, 2, 1, 3)
+    """Attention over (batch, seq, heads, head_dim) layout (model-friendly).
+
+    Alias for :func:`flash_attention_bshd` — kept for callers that predate
+    the layout-native kernels."""
+    return flash_attention_bshd(q, k, v, causal, sm_scale)

@@ -293,3 +293,60 @@ def test_pipeline_moe_train_step_learns():
         p, o, m1 = step(params, ost, {"tokens": tokens})
         _, _, m2 = step(p, o, {"tokens": tokens})
     assert float(m2["loss"]) < float(m1["loss"])
+
+
+@pytest.mark.parametrize("H,D", [(4, 32), (2, 64), (1, 128)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bshd_lane_path(H, D, causal):
+    """The (B, S, H, D) lane-layout kernels (head slices from 128-wide lane
+    blocks, fused whole-S backward) must match the dense reference — this is
+    the models' default attention path.  hpb = 128//D covers 4/2/1 heads per
+    lane block; fused single-pass bwd runs since S <= 1024."""
+    from ray_tpu.ops.flash_attention import (
+        _bshd_lanes_ok,
+        flash_attention_bshd,
+    )
+
+    B, S = 2, 128
+    key = jax.random.PRNGKey(1)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (B, S, H, D),
+                          jnp.float32) * 0.5
+        for i in range(3)
+    )
+    assert _bshd_lanes_ok(q, S, S, S)
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+
+    o = flash_attention_bshd(q, k, v, causal)
+    ref, _ = _reference_attention(tr(q), tr(k), tr(v), D ** -0.5, causal)
+    np.testing.assert_allclose(np.asarray(tr(o)), np.asarray(ref), atol=TOL)
+
+    def loss_lane(q, k, v):
+        return jnp.sum(flash_attention_bshd(q, k, v, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        o, _ = _reference_attention(tr(q), tr(k), tr(v), D ** -0.5, causal)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    gl = jax.grad(loss_lane, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gl, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   err_msg=f"{name} causal={causal} D={D}")
+
+
+def test_flash_attention_fused_bwd_mixed_dtypes():
+    """dk/dv must come back in k/v's dtype on the fused single-block paths
+    (regression: out_shape used q.dtype for all three)."""
+    B, H, S, D = 1, 2, 128, 32
+    q, k, v = _qkv(B, H, S, D)
+    k = k.astype(jnp.bfloat16)
+    v = v.astype(jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32))
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert dq.dtype == jnp.float32
+    assert dk.dtype == jnp.bfloat16
+    assert dv.dtype == jnp.bfloat16
