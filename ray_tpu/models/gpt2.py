@@ -188,7 +188,8 @@ def _moe_mlp(x, p, cfg: GPT2Config):
         counts = counts + jnp.sum(mj, axis=0)
     pos = jnp.stack(positions, axis=1)                          # (T, k, n)
     keep = mask * (pos < capacity)
-    slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)     # (T,k,n,C)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                    # (T,k,n,C)
     dispatch = jnp.einsum("tkn,tknc->tnc", keep, slot)
     combine = jnp.einsum("tk,tkn,tknc->tnc", gate_vals, keep, slot)
     expert_in = jnp.einsum("te,tnc->nce", xt,
@@ -234,35 +235,33 @@ def _trunk(params, tokens, cfg: GPT2Config, aux_acc=None,
     """Embedding + transformer blocks + final LN -> (B, S, E) in
     compute_dtype (the LN itself runs f32 for stability).  With stacked
     ``blocks`` params (see to_pipeline_params) the block stack runs as a
-    pipeline over the mesh pp axis (MoE aux loss is skipped on that path:
-    scalars can't ride the activation handoff)."""
+    pipeline over the mesh pp axis; MoE aux loss rides the stage handoff
+    as a scalar carry lane (averaged over microbatches)."""
     S = tokens.shape[1]
     x = (params["wte"]["embedding"][tokens]
          + params["wpe"]["embedding"][:S][None])
     x = x.astype(cfg.compute_dtype)
+    def block_with_aux(h, p):
+        acc: list = []
+        h2 = _block(h, p, cfg, acc)
+        aux = acc[0] if acc else jnp.zeros((), jnp.float32)
+        return h2, aux
+
     if "blocks" in params:
         from ray_tpu.parallel.context import require_mesh
         from ray_tpu.parallel.pipeline import pipeline_apply
 
-        if cfg.moe_experts > 0 and aux_acc is not None:
-            import warnings
-
-            warnings.warn(
-                "MoE load-balancing aux loss is not collected on the "
-                "pipeline-parallel path (scalars don't ride the stage "
-                "handoff); training optimizes cross-entropy only",
-                stacklevel=2)
-        x = pipeline_apply(
-            lambda p, h: _block(h, p, cfg),
+        # MoE aux rides the stage handoff as a scalar carry lane; the
+        # pipeline returns sum-over-layers of the per-microbatch-mean aux,
+        # so dividing by n_layer matches the sequential path's
+        # sum(aux_acc)/len(aux_acc).
+        x, pp_aux = pipeline_apply(
+            lambda p, h: block_with_aux(h, p),
             params["blocks"], x, require_mesh(), pp_microbatches)
+        if aux_acc is not None and cfg.moe_experts > 0:
+            aux_acc.append(pp_aux / cfg.n_layer)
     elif cfg.remat:
-        def _remat_body(h, p):
-            acc: list = []
-            h2 = _block(h, p, cfg, acc)
-            aux = acc[0] if acc else jnp.zeros((), jnp.float32)
-            return h2, aux
-
-        rblock = jax.checkpoint(_remat_body)
+        rblock = jax.checkpoint(block_with_aux)
         for i in range(cfg.n_layer):
             x, aux = rblock(x, params[f"h_{i}"])
             if aux_acc is not None and cfg.moe_experts > 0:
