@@ -355,7 +355,7 @@ def _pallas_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
-                     interpret):
+                     interpret, delta=None):
     B, H, S, D = q.shape
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
@@ -363,9 +363,12 @@ def _pallas_backward(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
     dof = do.reshape(B * H, S, D)
     lsef = lse.reshape(B * H, S, 1)
     # delta = rowsum(do * o): cheap elementwise+reduce, XLA fuses it.
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).reshape(B * H, S, 1)
+    # Callers looping over K/V chunks (ring attention) pass it precomputed
+    # — it only depends on the q side, so per-chunk recompute is waste.
+    if delta is None:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(B * H, S, 1)
 
     if block_q == block_k == S:
         # fused single-pass backward: shares s/dp across dq/dk/dv.
@@ -626,7 +629,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, delta=None):
     q, k, v, o, lse = res
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
@@ -635,7 +638,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     mode = _use_pallas(q, S, bq, bk)
     if mode is not None:
         return _pallas_backward(q, k, v, o, lse, do, scale, causal, bq, bk,
-                                interpret=not mode)
+                                interpret=not mode, delta=delta)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -648,7 +651,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     p = jnp.exp(s - lse[..., None])
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    if delta is None:
+        delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
     ds = p * (dp - delta[..., None]) * scale
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)

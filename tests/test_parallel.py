@@ -131,9 +131,13 @@ def test_ring_attention_grad():
         o, _ = _reference_attention(q, k, v, D ** -0.5, True)
         return (o ** 2).sum()
 
-    g1 = jax.grad(loss_ring)(q, k, v)
-    g2 = jax.grad(loss_ref)(q, k, v)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-2)
+    # all three grads: dq exercises the local accumulation, dk/dv the
+    # rotating ring accumulators of the hand-written backward
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   err_msg=name)
 
 
 def test_ulysses_attention_matches_dense():
