@@ -99,6 +99,17 @@ config.define("temp_dir", str, "/tmp/ray_tpu", "Session root directory.")
 config.define("prestart_workers", bool, True,
               "Start the worker pool eagerly at init (reference raylet "
               "prestarts workers, main.cc:48).")
+config.define("dispatch_batch_max", int, 16,
+              "Max same-shape normal tasks dispatched to one worker in a "
+              "single coalesced frame (they execute sequentially and hold "
+              "ONE task's resources; the worker requeues unstarted ones if "
+              "its current task blocks).  1 disables batching.")
+config.define("actor_pipeline_depth", int, 8,
+              "Max calls pipelined to a SYNC max_concurrency=1 actor ahead "
+              "of completion (the worker's single executor thread runs "
+              "them one at a time, so effective concurrency stays 1; this "
+              "just keeps its queue warm instead of paying a socket "
+              "round-trip of latency between calls).")
 config.define("health_check_period_s", float, 1.0, "")
 config.define("task_event_buffer_size", int, 10000,
               "Max buffered task state events for the state API.")

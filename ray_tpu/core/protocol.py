@@ -42,6 +42,29 @@ def send_msg(sock: socket.socket, msg: Any, lock=None):
         sock.sendall(frame)
 
 
+def send_msgs(sock: socket.socket, msgs, lock=None):
+    """Concatenate many frames into ONE sendall.
+
+    The receiver's recv_msg parses length-prefixed frames one at a time, so
+    coalescing is invisible to it.  The point is the syscall count: on a
+    busy host each sendall to a blocked peer costs a scheduler wakeup
+    (~100us measured on a contended 1-vCPU box) — one write for a 16-task
+    dispatch batch pays that once instead of 16 times."""
+    if not msgs:
+        return
+    parts = []
+    for msg in msgs:
+        data = pickle.dumps(msg, protocol=5)
+        parts.append(_LEN.pack(len(data)))
+        parts.append(data)
+    frame = b"".join(parts)
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     chunks = []
     while n:

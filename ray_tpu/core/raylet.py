@@ -25,6 +25,7 @@ their sockets.
 
 from __future__ import annotations
 
+import pickle
 import heapq
 import itertools
 import os
@@ -138,9 +139,14 @@ class _WorkerConn:
         # process death)
         self.held: Dict[ObjectID, int] = {}
         self.send_lock = threading.Lock()
+        self.rbuf = bytearray()  # partial-frame receive buffer
+        self.sent_fns: set = set()  # function ids this worker has cached
 
     def send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
+
+    def send_many(self, msgs):
+        protocol.send_msgs(self.sock, msgs, self.send_lock)
 
 
 class _ObjectState:
@@ -169,12 +175,13 @@ class _ObjectState:
 class _PeerConn:
     """Connection to another raylet (either dialed or accepted)."""
 
-    __slots__ = ("sock", "node_id", "send_lock")
+    __slots__ = ("sock", "node_id", "send_lock", "rbuf")
 
     def __init__(self, sock, node_id: str):
         self.sock = sock
         self.node_id = node_id
         self.send_lock = threading.Lock()
+        self.rbuf = bytearray()  # partial-frame receive buffer
 
     def send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
@@ -206,6 +213,18 @@ class _ActorState:
             getattr(spec, "concurrency_groups", None)
         self.restarts_left = spec.max_restarts
         self.death_reason = ""
+        # Sync plain actors (max_concurrency 1, no groups, non-asyncio —
+        # reported by the creation-done message) execute calls one at a
+        # time on the worker's main thread, so pipelining calls ahead of
+        # completion keeps effective concurrency at 1 while removing a
+        # socket round-trip of dead time between calls.
+        self.async_actor = False
+
+    def admit_limit(self) -> int:
+        if (self.max_concurrency == 1 and self.group_limits is None
+                and not self.async_actor):
+            return max(1, config.actor_pipeline_depth)
+        return self.max_concurrency
 
 
 class _PlacementGroup:
@@ -329,6 +348,9 @@ class Raylet:
         self._wake_r.setblocking(False)
         self._inbox: deque = deque()
         self._inbox_lock = threading.Lock()
+        # wake coalescing: a submission storm sends ONE wake byte per loop
+        # drain instead of one syscall per call_async
+        self._wake_armed = False
 
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
@@ -427,19 +449,25 @@ class Raylet:
 
         with self._inbox_lock:
             self._inbox.append(wrapper)
-        try:
-            self._wake_w.send(b"\x00")
-        except OSError:
-            pass
+            need_wake = not self._wake_armed
+            self._wake_armed = True
+        if need_wake:
+            try:
+                self._wake_w.send(b"\x00")
+            except OSError:
+                pass
         return fut
 
     def call_async(self, fn: Callable, *args):
         with self._inbox_lock:
             self._inbox.append(lambda: fn(*args))
-        try:
-            self._wake_w.send(b"\x00")
-        except OSError:
-            pass
+            need_wake = not self._wake_armed
+            self._wake_armed = True
+        if need_wake:
+            try:
+                self._wake_w.send(b"\x00")
+            except OSError:
+                pass
 
     # --------------------------------------------------------------- event loop
 
@@ -473,6 +501,8 @@ class Raylet:
                         self._wake_r.recv(4096)
                     except OSError:
                         pass
+                    with self._inbox_lock:
+                        self._wake_armed = False
                     self._drain_inbox()
                 elif kind == "worker":
                     # Never let a malformed message kill the event thread; a
@@ -556,15 +586,62 @@ class Raylet:
         self._workers[sock] = conn
         self._sel.register(sock, selectors.EVENT_READ, ("worker", conn))
 
+    @staticmethod
+    def _drain_frames(buf: bytearray, handle, alive):
+        """Handle every complete length-prefixed frame in ``buf``; stop
+        early (and leave the rest buffered) when ``alive()`` goes false —
+        a handler may kill or repurpose the connection mid-train."""
+        hdr = protocol._LEN.size
+        while alive():
+            if len(buf) < hdr:
+                return
+            (length,) = protocol._LEN.unpack_from(buf)
+            if len(buf) < hdr + length:
+                return
+            msg = pickle.loads(bytes(buf[hdr:hdr + length]))
+            del buf[:hdr + length]
+            handle(msg)
+
     def _on_worker_readable(self, conn: _WorkerConn):
+        """Buffered frame reader: ONE recv drains everything the kernel has
+        for this socket (workers coalesce done bursts into frame trains),
+        then every complete frame is handled — instead of one recv + one
+        select() iteration per message."""
         try:
-            msg = protocol.recv_msg(conn.sock)
+            data = conn.sock.recv(1 << 20)
         except OSError:
-            msg = None
-        if msg is None:
+            data = b""
+        if not data:
             self._on_worker_death(conn)
             return
-        self._handle_worker_msg(conn, msg)
+        conn.rbuf += data
+        self._drain_frames(
+            conn.rbuf,
+            lambda msg: self._handle_worker_msg(conn, msg),
+            lambda: self._workers.get(conn.sock) is conn)
+        if self._workers.get(conn.sock) is conn:
+            return
+        # The conn left _workers mid-train: either it died (socket closed,
+        # buffer moot) or a peer_hello promoted it to a raylet peer — any
+        # remaining buffered frames belong to the peer protocol.
+        try:
+            kind, peer = self._sel.get_key(conn.sock).data
+        except (KeyError, ValueError):
+            return
+        if kind == "peer" and conn.rbuf:
+            peer.rbuf += conn.rbuf
+            conn.rbuf = bytearray()
+            self._drain_frames(
+                peer.rbuf,
+                lambda msg: self._handle_peer_msg(peer, msg),
+                lambda: self._peer_alive(peer))
+
+    def _peer_alive(self, peer) -> bool:
+        try:
+            kind, cur = self._sel.get_key(peer.sock).data
+        except (KeyError, ValueError):
+            return False
+        return kind == "peer" and cur is peer
 
     # --------------------------------------------------------------- workers
 
@@ -856,6 +933,20 @@ class Raylet:
             self._schedule()
         elif t == "done":
             self._on_task_done(conn, msg)
+        elif t == "requeue":
+            # the worker's current task blocked (nested get/wait) with
+            # unstarted batch members queued behind it — take them back so
+            # they can run elsewhere instead of waiting out the block.
+            # Use the raylet-side spec objects (conn.inflight) — they carry
+            # the batch accounting the wire copies don't.
+            for wire_spec in msg["specs"]:
+                spec = conn.inflight.pop(wire_spec.task_id, None)
+                if spec is None:
+                    continue  # already completed/raced
+                self._release_task_resources(spec)
+                self._record_event(spec, "REQUEUED")
+                self._enqueue_ready(spec)
+            self._schedule()
         elif t == "stream_item":
             self._on_stream_item(msg)
         elif t == "ref_events":
@@ -922,11 +1013,17 @@ class Raylet:
                 actor.conn = conn
                 actor.node_id = None  # executing locally, whatever was tried
                 conn.state = "actor"
+                # sync/async execution model, reported by the worker after
+                # instantiation — gates call pipelining (admit_limit)
+                actor.async_actor = bool(msg.get("async_actor"))
         elif actor is not None:
             if not conn.inflight:
                 conn.state = "actor"
         else:
-            self._return_worker(conn)
+            # batched dispatch: the worker still has queued batch members;
+            # it returns to the pool only when the last one completes.
+            if not conn.inflight:
+                self._return_worker(conn)
         if retrying:
             spec.retries_left -= 1
             self._record_event(spec, "RETRYING")
@@ -1201,13 +1298,17 @@ class Raylet:
 
     def _on_peer_readable(self, peer: _PeerConn):
         try:
-            msg = protocol.recv_msg(peer.sock)
+            data = peer.sock.recv(1 << 20)
         except OSError:
-            msg = None
-        if msg is None:
+            data = b""
+        if not data:
             self._drop_peer(peer)
             return
-        self._handle_peer_msg(peer, msg)
+        peer.rbuf += data
+        self._drain_frames(
+            peer.rbuf,
+            lambda msg: self._handle_peer_msg(peer, msg),
+            lambda: self._peer_alive(peer))
 
     def _handle_peer_msg(self, peer: _PeerConn, msg: dict):
         t = msg["t"]
@@ -2084,6 +2185,17 @@ class Raylet:
         return self.resources_available, spec.resources
 
     def _release_task_resources(self, spec: TaskSpec):
+        batch = getattr(spec, "_batch", None)
+        if batch is not None:
+            # sequential dispatch batch: the batch holds ONE task's
+            # resources, released when its last member finishes (done,
+            # death, or requeue — each path comes through here exactly
+            # once per member).
+            spec._batch = None
+            batch["open"] -= 1
+            if batch["open"] == 0:
+                _release(batch["pool"], batch["need"])
+            return
         pool = getattr(spec, "_acquired_pool", None)
         if pool is not None:
             _release(pool, spec.resources)
@@ -2135,6 +2247,32 @@ class Raylet:
         self._activate_pending_pgs()
         if not self._ready_queue:
             return
+        # Fast bail: with zero idle workers and every near-head profile's
+        # pool already at the per-profile spawn cap, a pass can neither
+        # dispatch nor usefully spawn — and done-storms request one pass
+        # per completion batch, so the deferred-queue rotation below would
+        # run O(completions) times.  Actor tasks in the ready queue (retry
+        # rejoin path) always force a full pass — they route through the
+        # actor machinery, not the worker pool.
+        if (not self.cluster_mode
+                and not any(self._idle.values())):
+            cap = max(1, int(self.resources_total.get("CPU", 1) or 1))
+            poolable: Dict[str, int] = {}
+            for c in self._workers.values():
+                if c.actor_id is None and c.state in ("idle", "busy"):
+                    poolable[c.profile] = poolable.get(c.profile, 0) + 1
+            for prof, n in self._spawning.items():
+                poolable[prof] = poolable.get(prof, 0) + n
+            can_bail = True
+            for s in itertools.islice(self._ready_queue, 32):
+                if (s.kind == ACTOR_TASK
+                        or poolable.get(self._profile_key(s), 0) < cap):
+                    can_bail = False
+                    break
+            if can_bail:
+                # every completion calls _schedule(), so the next pass is
+                # already guaranteed once a worker frees
+                return
         deferred = deque()
         spawn_demand: Dict[str, int] = {}
         pg_orphans = []  # tasks whose PG no longer exists — fail after drain
@@ -2305,9 +2443,45 @@ class Raylet:
                 deferred.append(spec)
                 no_progress += 1
                 continue
+            batch = [spec]
+            # Fair share: never batch deeper than the queue spread over
+            # the workers that could also take this shape — a fan-out of 8
+            # tasks with 8 idle workers must not serialize onto one.
+            idle_same = len(self._idle.get(profile, ()))
+            fair = -(-(len(self._ready_queue) + 1) // (idle_same + 1))
+            batch_cap = min(config.dispatch_batch_max, fair)
+            if (shape_key is not None and batch_cap > 1
+                    and self._ready_queue):
+                # Same-shape followers from the queue head ride the same
+                # coalesced frame (ONE sendall — the syscall, not the
+                # pickle, is the per-dispatch cost on a busy host) and
+                # execute sequentially on this worker, so the whole batch
+                # holds one task's resources.  Consecutive-head-only keeps
+                # FIFO order; the first non-matching spec stops the batch.
+                while (len(batch) < batch_cap
+                       and self._ready_queue):
+                    nxt = self._ready_queue[0]
+                    if (nxt.kind != NORMAL_TASK or nxt.placement
+                            or self._profile_key(nxt) != profile
+                            or tuple(sorted((nxt.resources or {}).items()))
+                            != shape_key):
+                        break
+                    self._ready_queue.popleft()
+                    if self._dep_errored(nxt):
+                        continue
+                    if self._remote_deps_pending(nxt):
+                        deferred.append(nxt)
+                        continue
+                    batch.append(nxt)
             _acquire(pool, need)
-            spec._acquired_pool = pool
-            self._dispatch(spec, conn)
+            if len(batch) == 1:
+                spec._acquired_pool = pool
+            else:
+                rec = {"open": len(batch), "pool": pool, "need": need}
+                for s in batch:
+                    s._batch = rec
+                    s._acquired_pool = None
+            self._dispatch_many(batch, conn)
             no_progress = 0
         deferred.extend(self._ready_queue)  # early-break keeps the tail
         self._ready_queue = deferred
@@ -2355,7 +2529,7 @@ class Raylet:
             for _ in range(max(0, want)):
                 self._spawn_worker(profile)
 
-    def _dispatch(self, spec: TaskSpec, conn: _WorkerConn):
+    def _dispatch_msg(self, spec: TaskSpec, conn: _WorkerConn) -> dict:
         conn.state = "busy"
         conn.current_task = spec
         conn.task_start_time = time.monotonic()
@@ -2372,14 +2546,47 @@ class Raylet:
         fn_blob = None
         if spec.function_id is not None:
             key = spec.function_id.binary()
-            fn_blob = self._fn_cache.get(key)
-            if fn_blob is None:
-                fn_blob = self._gcs_safe(self.gcs.get_function, key)
-                if fn_blob is not None:
-                    self._fn_cache[key] = fn_blob
+            if spec.function_blob is not None and not self.cluster_mode:
+                # Strip the inline blob off the wire spec: workers cache
+                # the function by id after the first dispatch, so
+                # re-pickling the blob for every task of a flood is pure
+                # waste.  The blob moves to the GCS function table (the
+                # local LRU below may evict it — a closure-minting driver
+                # must not pin every blob in raylet memory) and the
+                # export-once growth matches reference function-manager
+                # semantics.  (Cluster mode keeps it inline — forwarded
+                # specs must stay self-contained for peers.)
+                if key not in self._fn_cache:
+                    self._gcs_safe(self.gcs.put_function, key,
+                                   spec.function_blob)
+                    self._fn_cache[key] = spec.function_blob
+                spec.function_blob = None
+            if key not in conn.sent_fns:
+                fn_blob = self._fn_cache.get(key)
+                if fn_blob is None:
+                    fn_blob = self._gcs_safe(self.gcs.get_function, key)
+                    if fn_blob is not None:
+                        self._fn_cache[key] = fn_blob
+                if len(conn.sent_fns) > (1 << 16):
+                    conn.sent_fns.clear()  # worker re-fetches; bounded set
+                conn.sent_fns.add(key)
+            if len(self._fn_cache) > 512:  # bounded write-through cache
+                self._fn_cache.pop(next(iter(self._fn_cache)))
         self._record_event(spec, "RUNNING", pid=conn.pid)
-        conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
-                   "fn_blob": fn_blob})
+        return {"t": "task", "spec": spec, "arg_values": arg_values,
+                "fn_blob": fn_blob}
+
+    def _dispatch(self, spec: TaskSpec, conn: _WorkerConn):
+        conn.send(self._dispatch_msg(spec, conn))
+
+    def _dispatch_many(self, specs: List[TaskSpec], conn: _WorkerConn):
+        """Dispatch a sequential batch in one coalesced frame; the worker
+        sees ordinary per-task messages (recv_msg splits the frames) and
+        runs them in order.  current_task ends as specs[0] — the one the
+        worker starts executing first."""
+        msgs = [self._dispatch_msg(s, conn) for s in specs]
+        conn.current_task = specs[0]
+        conn.send_many(msgs)
 
     def _pump_actor(self, actor: _ActorState):
         if actor.node_id is not None and actor.node_id != self.node_id:
@@ -2417,8 +2624,9 @@ class Raylet:
         # (FIFO is preserved WITHIN each group — skipped specs keep their
         # relative order in the deferred queue).
         deferred_groups: deque = deque()
+        out_msgs = []
         while (actor.state == "alive" and actor.conn is not None
-               and actor.queue and len(actor.inflight) < actor.max_concurrency):
+               and actor.queue and len(actor.inflight) < actor.admit_limit()):
             spec = actor.queue.popleft()
             if self._dep_errored(spec):
                 continue
@@ -2452,8 +2660,11 @@ class Raylet:
                 if st is not None and st.status == "inline":
                     arg_values[oid.hex()] = st.value
             self._record_event(spec, "RUNNING", pid=conn.pid)
-            conn.send({"t": "task", "spec": spec, "arg_values": arg_values,
-                       "fn_blob": None})
+            out_msgs.append({"t": "task", "spec": spec,
+                             "arg_values": arg_values, "fn_blob": None})
+        if out_msgs and actor.conn is not None:
+            # one coalesced frame for the whole pump (one sendall)
+            actor.conn.send_many(out_msgs)
         # put group-saturated specs back at the FRONT, preserving order
         while deferred_groups:
             actor.queue.appendleft(deferred_groups.pop())

@@ -18,6 +18,7 @@ import queue
 import socket
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
@@ -54,8 +55,27 @@ class RemoteWorker(Worker):
         self._rid = 0
         self._rid_lock = threading.Lock()
         self._pending: Dict[int, dict] = {}
+        # Done-message coalescing for batched dispatch: while more tasks
+        # wait in the local queue, done frames buffer and flush in ONE
+        # sendall when the queue drains (or before any blocking request) —
+        # each sendall to the busy raylet costs a scheduler wakeup.  A
+        # background flusher bounds the staleness to ~2ms so a fast task's
+        # result is never held hostage by a slow batch member running
+        # behind it.
+        self._done_buf: list = []
+        self._done_lock = threading.Lock()
+        self._done_pending = threading.Event()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher.start()
+
+    def _flush_loop(self):
+        while True:
+            self._done_pending.wait()
+            time.sleep(0.002)  # let a fast burst coalesce
+            self._done_pending.clear()
+            self.flush_dones()
 
     def _read_loop(self):
         while True:
@@ -79,17 +99,67 @@ class RemoteWorker(Worker):
     def _send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
 
+    def send_done(self, msg):
+        """Send a task-completion message, coalescing with neighbors while
+        batched work is still queued locally (flushed at queue drain,
+        before any blocking request, or by the ~2ms background flusher)."""
+        with self._done_lock:
+            self._done_buf.append(msg)
+            if not self.task_queue.empty():
+                self._done_pending.set()
+                return
+            buf, self._done_buf = self._done_buf, []
+        protocol.send_msgs(self.sock, buf, self.send_lock)
+
+    def flush_dones(self):
+        with self._done_lock:
+            buf, self._done_buf = self._done_buf, []
+        if buf:
+            protocol.send_msgs(self.sock, buf, self.send_lock)
+
+    def requeue_pending_tasks(self):
+        """Hand unstarted batched tasks back to the raylet — called before
+        blocking (nested get/wait): the current task may wait on work that
+        would otherwise sit behind it in this worker's own queue.  Pool
+        workers only — actor calls are pinned to their worker (and an actor
+        worker's queue order must not be disturbed)."""
+        if self.actor_instance is not None:
+            return
+        give_back = []
+        try:
+            while True:
+                give_back.append(self.task_queue.get_nowait()["spec"])
+        except queue.Empty:
+            pass
+        if give_back:
+            self._send({"t": "requeue", "specs": give_back})
+
     def _request(self, op, _wait_timeout=None, **fields):
         """Round-trip to the raylet.  ``_wait_timeout`` bounds the local wait
         (used by get/wait with a user timeout): on expiry the request is
         cancelled raylet-side and TimeoutError raised here."""
+        self.flush_dones()  # the raylet must see completions before we wait
         with self._rid_lock:
             self._rid += 1
             rid = self._rid
         entry = {"event": threading.Event(), "msg": None}
         self._pending[rid] = entry
         self._send({"t": "request", "rid": rid, "op": op, **fields})
-        if not entry["event"].wait(_wait_timeout):
+        remaining = _wait_timeout
+        if (op in ("get", "wait", "stream_next")
+                and not self.task_queue.empty()):
+            # Grace period before handing batched tasks back: a get that
+            # the raylet satisfies immediately must not trigger a
+            # requeue/redispatch churn cycle.  Only an ACTUALLY-blocking
+            # request gives the queue back.
+            grace = 0.01 if remaining is None else min(0.01, remaining)
+            if entry["event"].wait(grace):
+                remaining = 0
+            else:
+                if remaining is not None:
+                    remaining -= grace
+                self.requeue_pending_tasks()
+        if not entry["event"].wait(remaining):
             self._pending.pop(rid, None)
             self._send({"t": "request", "rid": rid + (1 << 62), "op":
                         "cancel_request", "target_rid": rid})
@@ -270,13 +340,13 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
             *args, **kwargs
         )
         inline, stored, sizes = _package_results(worker, spec, result)
-        worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
-                      "inline": inline, "stored": stored, "sizes": sizes})
+        worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
+                          "inline": inline, "stored": stored, "sizes": sizes})
         return True
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
-        worker._send({
+        worker.send_done({
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
@@ -297,6 +367,7 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
     from ray_tpu.runtime_context import _current_task_id
 
     _ctx_token = _current_task_id.set(spec.task_id)
+    extra: dict = {}
     try:
         if msg.get("__bad_group__") is not None:
             raise ValueError(
@@ -309,9 +380,13 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
             worker.actor_instance = cls(*args, **kwargs)
             worker.current_actor_id = spec.actor_id
             _setup_actor_concurrency(worker, spec)
+            # the raylet pipelines calls only to sync actors — report the
+            # execution model it can't otherwise see
+            extra["async_actor"] = worker.actor_loop is not None
             result = None
         elif spec.kind == ACTOR_TASK:
             if spec.method_name == "__ray_terminate__":
+                worker.flush_dones()
                 worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
                               "inline": {spec.return_ids()[0].hex():
                                          serialization.dumps(None)},
@@ -334,13 +409,14 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         if spec.num_returns == STREAMING_RETURNS:
             result = _run_streaming(worker, spec, result)
         inline, stored, sizes = _package_results(worker, spec, result)
-        worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
-                      "inline": inline, "stored": stored, "sizes": sizes})
+        worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
+                          "inline": inline, "stored": stored, "sizes": sizes,
+                          **extra})
         return True
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
-        worker._send({
+        worker.send_done({
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
