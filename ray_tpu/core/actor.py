@@ -63,7 +63,7 @@ class ActorHandle:
 
     def _invoke(self, method_name, args, kwargs, opts):
         worker = global_worker()
-        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        out_args, out_kwargs, inner_refs = worker._prepare_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
         if streaming:
@@ -76,6 +76,7 @@ class ActorHandle:
             name=f"{self._class_name}.{method_name}",
             args=out_args,
             kwargs=out_kwargs,
+            inner_refs=inner_refs or None,
             num_returns=num_returns,
             actor_id=self._actor_id,
             method_name=method_name,
@@ -132,7 +133,7 @@ class ActorClass:
         opts.setdefault("num_cpus", 0)
         worker = global_worker()
         fid, blob = worker.register_function(self._cls)
-        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        out_args, out_kwargs, inner_refs = worker._prepare_args(args, kwargs)
         actor_id = ActorID.from_random()
         max_restarts = opts.get("max_restarts",
                                 config.actor_max_restarts_default)
@@ -180,6 +181,7 @@ class ActorClass:
             function_id=fid,
             args=out_args,
             kwargs=out_kwargs,
+            inner_refs=inner_refs or None,
             num_returns=1,
             resources=_build_resources(opts),
             max_restarts=max_restarts,

@@ -9,7 +9,35 @@ owner as the driver, so serializing a ref is just shipping its ID).
 
 from __future__ import annotations
 
+import threading
+
 from ray_tpu.core.ids import ObjectID
+
+# Serialization-time ref collection (the borrow-pinning protocol's first
+# half): while a collector is installed on this thread, every ObjectRef
+# pickled records its id.  The serializer returns those ids alongside the
+# bytes, and whatever entity comes to OWN the bytes (an object entry, a
+# task spec) pins the inner objects until it is itself released — so a ref
+# travelling inside a serialized value can never be freed out from under
+# the eventual deserializer (reference: borrowed-ref tracking,
+# `src/ray/core_worker/reference_count.h:233`).
+_collect = threading.local()
+
+
+class collect_serialized_refs:
+    """Context manager installing a per-thread inner-ref collector."""
+
+    def __init__(self):
+        self.ids = []
+
+    def __enter__(self):
+        self._prev = getattr(_collect, "sink", None)
+        _collect.sink = self.ids
+        return self
+
+    def __exit__(self, *exc):
+        _collect.sink = self._prev
+        return False
 
 
 class ObjectRef:
@@ -83,6 +111,9 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        sink = getattr(_collect, "sink", None)
+        if sink is not None:
+            sink.append(self._id)
         return (ObjectRef, (self._id,))
 
 

@@ -65,6 +65,23 @@ def send_msgs(sock: socket.socket, msgs, lock=None):
         sock.sendall(frame)
 
 
+def drain_frames(buf: bytearray, handle, alive) -> None:
+    """Handle every complete length-prefixed frame in ``buf`` (the
+    receive-side counterpart of send_msgs' coalescing); stops early —
+    leaving the rest buffered — when ``alive()`` goes false, so a handler
+    may kill or repurpose the connection mid-train."""
+    hdr = _LEN.size
+    while alive():
+        if len(buf) < hdr:
+            return
+        (length,) = _LEN.unpack_from(buf)
+        if len(buf) < hdr + length:
+            return
+        msg = pickle.loads(bytes(buf[hdr:hdr + length]))
+        del buf[:hdr + length]
+        handle(msg)
+
+
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     chunks = []
     while n:

@@ -25,7 +25,7 @@ their sockets.
 
 from __future__ import annotations
 
-import pickle
+
 import heapq
 import itertools
 import os
@@ -152,7 +152,7 @@ class _WorkerConn:
 class _ObjectState:
     __slots__ = ("status", "value", "error", "size", "locations",
                  "holders", "pins", "tracked", "creating_spec",
-                 "free_armed")
+                 "free_armed", "contains")
 
     def __init__(self):
         # pending | inline | store | remote | error
@@ -170,6 +170,10 @@ class _ObjectState:
         self.tracked = False    # ever held => eligible for auto-free
         self.creating_spec: Optional["TaskSpec"] = None  # lineage
         self.free_armed = False
+        # ObjectIDs of refs serialized INSIDE this object's bytes: each is
+        # pinned while this entry lives (borrow pinning — an inner ref must
+        # outlive the blob that mentions it, however long it sits unread).
+        self.contains: Optional[List["ObjectID"]] = None
 
 
 class _PeerConn:
@@ -586,21 +590,7 @@ class Raylet:
         self._workers[sock] = conn
         self._sel.register(sock, selectors.EVENT_READ, ("worker", conn))
 
-    @staticmethod
-    def _drain_frames(buf: bytearray, handle, alive):
-        """Handle every complete length-prefixed frame in ``buf``; stop
-        early (and leave the rest buffered) when ``alive()`` goes false —
-        a handler may kill or repurpose the connection mid-train."""
-        hdr = protocol._LEN.size
-        while alive():
-            if len(buf) < hdr:
-                return
-            (length,) = protocol._LEN.unpack_from(buf)
-            if len(buf) < hdr + length:
-                return
-            msg = pickle.loads(bytes(buf[hdr:hdr + length]))
-            del buf[:hdr + length]
-            handle(msg)
+    _drain_frames = staticmethod(protocol.drain_frames)
 
     def _on_worker_readable(self, conn: _WorkerConn):
         """Buffered frame reader: ONE recv drains everything the kernel has
@@ -989,12 +979,15 @@ class Raylet:
                 inline: Dict[str, bytes] = msg.get("inline", {})
                 stored: List[str] = msg.get("stored", [])
                 sizes: Dict[str, int] = msg.get("sizes", {})
+                contains: Dict[str, list] = msg.get("contains", {})
                 for hex_id, blob in inline.items():
-                    self._object_inline(ObjectID.from_hex(hex_id), blob)
+                    self._object_inline(ObjectID.from_hex(hex_id), blob,
+                                        contains=contains.get(hex_id))
                 for hex_id in stored:
                     oid = ObjectID.from_hex(hex_id)
                     self._obj(oid).size = sizes.get(hex_id, 0)
-                    self._object_in_store(oid)
+                    self._object_in_store(oid,
+                                          contains=contains.get(hex_id))
                 self._record_event(spec, "FINISHED")
         # worker back to pool / actor next call
         if spec.kind == ACTOR_CREATION_TASK:
@@ -1399,13 +1392,18 @@ class Raylet:
         if peer is None:
             return  # origin node is gone; results stay locally
         out = {}
+        contains = {}
         for h, r in results.items():
             if r[0] == "store":
                 out[h] = ("store", self.node_id)
             else:
                 out[h] = r
+            st = self._objects.get(ObjectID.from_hex(h))
+            if st is not None and st.contains:
+                contains[h] = st.contains  # owner re-pins the inner refs
         try:
-            peer.send({"t": "xdone", "task_id": spec.task_id, "results": out})
+            peer.send({"t": "xdone", "task_id": spec.task_id, "results": out,
+                       "contains": contains})
         except OSError:
             self._drop_peer(peer)
 
@@ -1413,15 +1411,17 @@ class Raylet:
         entry = self._forwarded.pop(msg["task_id"], None)
         spec = entry[0] if entry else None
         failed = False
+        contains = msg.get("contains", {})
         for h, r in msg["results"].items():
             oid = ObjectID.from_hex(h)
             if r[0] == "inline":
-                self._object_inline(oid, r[1])
+                self._object_inline(oid, r[1], contains=contains.get(h))
             elif r[0] == "error":
                 failed = True
                 self._object_error(oid, r[1])
             else:  # ("store", node_id)
                 st = self._obj(oid)
+                self._set_contains(st, contains.get(h))
                 if st.status in ("pending", "remote"):
                     st.status = "remote"
                     if r[1] not in st.locations:
@@ -1750,6 +1750,30 @@ class Raylet:
     def release_refs(self, oids: List[ObjectID]):
         self.apply_ref_events([("r", o) for o in oids])
 
+    def drop_object(self, oid: ObjectID):
+        """Explicit user free: remove the entry now, releasing any borrow
+        pins its bytes held on inner refs."""
+        st = self._objects.pop(oid, None)
+        if st is None:
+            return
+        if st.creating_spec is not None:
+            self._lineage_count -= 1
+        if st.status == "store":
+            store = self._raylet_store()
+            if store is not None:
+                try:
+                    store.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+        if st.contains:
+            for inner in st.contains:
+                inner_st = self._objects.get(inner)
+                if inner_st is not None:
+                    inner_st.pins -= 1
+                    self._maybe_free(inner)
+        if self.cluster_mode:
+            self._gcs_post("remove_object_location", oid.hex(), self.node_id)
+
     def _maybe_free(self, oid: ObjectID):
         st = self._objects.get(oid)
         if (st is None or not st.tracked or st.holders > 0 or st.pins > 0
@@ -1784,14 +1808,28 @@ class Raylet:
                     store.delete(oid)
                 except Exception:  # noqa: BLE001
                     pass
+        if st.contains:
+            # this blob's inner refs lose their borrow pins; they free in
+            # turn once nothing else holds them
+            for inner in st.contains:
+                inner_st = self._objects.get(inner)
+                if inner_st is not None:
+                    inner_st.pins -= 1
+                    self._maybe_free(inner)
         if self.cluster_mode:
             self._gcs_post("remove_object_location", oid.hex(), self.node_id)
 
     def _pin_deps(self, spec: TaskSpec):
-        """Pin dependency objects for the task's lifetime: released when
-        every return resolves (the same all-paths completion signal the
-        cluster xdone path uses)."""
-        deps = spec.dependency_ids()
+        """Pin dependency objects — declared top-level deps AND refs
+        serialized inside inline arg values (spec.inner_refs, the borrow
+        pins) — for the task's lifetime: released when every return
+        resolves (the same all-paths completion signal the cluster xdone
+        path uses).  The executor's own hold announcements are flushed
+        ahead of its done message, so by release time any ref the task
+        kept is already counted."""
+        deps = list(spec.dependency_ids())
+        if spec.inner_refs:
+            deps += spec.inner_refs
         if not deps:
             return
         for oid in deps:
@@ -1861,10 +1899,11 @@ class Raylet:
         """A generator task yielded item #index (worker message)."""
         oid = ObjectID.from_hex(msg["id"])
         if msg.get("inline") is not None:
-            self._object_inline(oid, msg["inline"])
+            self._object_inline(oid, msg["inline"],
+                                contains=msg.get("contains"))
         else:
             self._obj(oid).size = msg.get("size", 0)
-            self._object_in_store(oid)
+            self._object_in_store(oid, contains=msg.get("contains"))
         tid = oid.task_id()
         origin = self._foreign_streams.get(tid)
         if origin is not None:
@@ -1980,19 +2019,36 @@ class Raylet:
             self._objects[oid] = st
         return st
 
-    def _object_inline(self, oid: ObjectID, blob: bytes):
+    def _set_contains(self, st: "_ObjectState", contains):
+        """Record + pin the refs serialized inside this object's bytes;
+        released when the entry itself is freed."""
+        if not contains:
+            return
+        if st.contains:
+            # re-seal (retry/reconstruction): drop the old pins first
+            for inner in st.contains:
+                inner_st = self._objects.get(inner)
+                if inner_st is not None:
+                    inner_st.pins -= 1
+        st.contains = list(contains)
+        for inner in st.contains:
+            self._obj(inner).pins += 1
+
+    def _object_inline(self, oid: ObjectID, blob: bytes, contains=None):
         st = self._obj(oid)
         st.status = "inline"
         st.value = blob
         st.size = len(blob)
+        self._set_contains(st, contains)
         if self.cluster_mode:
             self._gcs_post("add_object_location", oid.hex(),
                            self.node_id, len(blob), inline=True)
         self._object_ready(oid)
 
-    def _object_in_store(self, oid: ObjectID):
+    def _object_in_store(self, oid: ObjectID, contains=None):
         st = self._obj(oid)
         st.status = "store"
+        self._set_contains(st, contains)
         if self.cluster_mode:
             self._gcs_post("add_object_location", oid.hex(),
                            self.node_id, st.size)
@@ -2263,8 +2319,11 @@ class Raylet:
                     poolable[c.profile] = poolable.get(c.profile, 0) + 1
             for prof, n in self._spawning.items():
                 poolable[prof] = poolable.get(prof, 0) + n
+            # Window = the full pass's no-progress bound: entries beyond it
+            # were unreachable in a defer-storm pass anyway, so the bail
+            # never hides work a full pass would have found.
             can_bail = True
-            for s in itertools.islice(self._ready_queue, 32):
+            for s in itertools.islice(self._ready_queue, 128):
                 if (s.kind == ACTOR_TASK
                         or poolable.get(self._profile_key(s), 0) < cap):
                     can_bail = False
@@ -2444,11 +2503,22 @@ class Raylet:
                 no_progress += 1
                 continue
             batch = [spec]
-            # Fair share: never batch deeper than the queue spread over
-            # the workers that could also take this shape — a fan-out of 8
+            # Fair share: never batch deeper than the queue spread over the
+            # workers that could also take this shape — a fan-out of 8
             # tasks with 8 idle workers must not serialize onto one.
+            # SPAWNABLE workers count too: batching the whole queue onto
+            # the only live worker would consume the very backlog whose
+            # no-idle-worker signal drives pool growth, freezing the pool
+            # at its current size.
             idle_same = len(self._idle.get(profile, ()))
-            fair = -(-(len(self._ready_queue) + 1) // (idle_same + 1))
+            pool_same = self._spawning.get(profile, 0) + sum(
+                1 for c in self._workers.values()
+                if c.actor_id is None and c.state in ("idle", "busy")
+                and c.profile == profile)
+            cpu_cap = max(1, int(self.resources_total.get("CPU", 1) or 1))
+            spawnable = max(0, cpu_cap - pool_same)
+            fair = -(-(len(self._ready_queue) + 1)
+                     // (idle_same + spawnable + 1))
             batch_cap = min(config.dispatch_batch_max, fair)
             if (shape_key is not None and batch_cap > 1
                     and self._ready_queue):
@@ -2843,13 +2913,14 @@ class Raylet:
                 if cancel is not None:
                     conn.request_cancels[rid] = cancel
             elif op == "put_inline":
-                self._object_inline(ObjectID.from_hex(msg["id"]), msg["blob"])
+                self._object_inline(ObjectID.from_hex(msg["id"]), msg["blob"],
+                                    contains=msg.get("contains"))
                 reply()
             elif op == "register_stored":
                 oid = ObjectID.from_hex(msg["id"])
                 if "size" in msg:
                     self._obj(oid).size = msg["size"]
-                self._object_in_store(oid)
+                self._object_in_store(oid, contains=msg.get("contains"))
                 reply()
             elif op == "kv_put":
                 self.gcs.kv_put(msg["ns"], msg["key"], msg["val"])
@@ -2899,10 +2970,7 @@ class Raylet:
                     reply(value=info["state"] if info else None)
             elif op == "free":
                 for h in msg["ids"]:
-                    self._objects.pop(ObjectID.from_hex(h), None)
-                    if self.cluster_mode:
-                        self._gcs_post("remove_object_location",
-                                       h, self.node_id)
+                    self.drop_object(ObjectID.from_hex(h))
                 reply()
             elif op == "stream_next":
                 cancel = self.async_stream_next(

@@ -67,7 +67,7 @@ class RemoteFunction:
     def _remote(self, args, kwargs, opts):
         worker = global_worker()
         fid, blob = worker.register_function(self._function)
-        out_args, out_kwargs = worker._prepare_args(args, kwargs)
+        out_args, out_kwargs, inner_refs = worker._prepare_args(args, kwargs)
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
         if streaming:
@@ -84,6 +84,7 @@ class RemoteFunction:
             function_id=fid,
             args=out_args,
             kwargs=out_kwargs,
+            inner_refs=inner_refs or None,
             num_returns=num_returns,
             resources=_build_resources(opts),
             max_retries=max_retries,

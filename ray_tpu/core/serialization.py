@@ -117,6 +117,17 @@ def serialize(obj: Any) -> SerializedObject:
     return SerializedObject(pickled, buffers)
 
 
+def serialize_with_refs(obj: Any):
+    """serialize() + the ObjectIDs of every ObjectRef pickled inside the
+    value — callers pin those ids for the serialized bytes' lifetime (the
+    borrow-pinning protocol; see object_ref.collect_serialized_refs)."""
+    from ray_tpu.core.object_ref import collect_serialized_refs
+
+    with collect_serialized_refs() as c:
+        ser = serialize(obj)
+    return ser, c.ids
+
+
 def deserialize(data: memoryview) -> Any:
     magic, n, plen = _HEADER.unpack_from(data, 0)
     if magic != _MAGIC:

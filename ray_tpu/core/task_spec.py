@@ -59,6 +59,11 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # Placement: pg id hex + bundle index, or node-affinity
     placement: Optional[dict] = None
+    # ObjectIDs of refs serialized INSIDE inline arg values (not declared
+    # top-level deps): pinned alongside deps until the task completes so
+    # the executor can still resolve them however late it deserializes
+    # (borrow pinning; reference: reference_count.h:233).
+    inner_refs: Optional[List[ObjectID]] = None
     # Owner bookkeeping
     submitter: str = "driver"
     # Tracing: submit-span context {trace_id, span_id} propagated to the

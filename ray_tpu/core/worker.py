@@ -140,24 +140,30 @@ class Worker:
     def _serialize_value(self, value) -> serialization.SerializedObject:
         return serialization.serialize(value)
 
-    def _prepare_args(self, args: Sequence, kwargs: Dict) -> Tuple[list, list]:
+    def _prepare_args(self, args: Sequence, kwargs: Dict):
         """Top-level ObjectRef args become dependencies; plain values are
         serialized inline, or promoted to the store when large (reference:
         LocalDependencyResolver inlines small args,
-        `transport/dependency_resolver.cc`)."""
+        `transport/dependency_resolver.cc`).  Returns (args, kwargs,
+        inner_refs) — inner_refs are ObjectIDs of refs serialized INSIDE
+        inline values; the spec pins them until the task completes."""
+        inner: list = []
         out_args = []
         for a in args:
-            out_args.append(self._prepare_arg(a))
-        out_kwargs = [(k, self._prepare_arg(v)) for k, v in kwargs.items()]
-        return out_args, out_kwargs
+            out_args.append(self._prepare_arg(a, inner))
+        out_kwargs = [(k, self._prepare_arg(v, inner))
+                      for k, v in kwargs.items()]
+        return out_args, out_kwargs, inner
 
-    def _prepare_arg(self, value):
+    def _prepare_arg(self, value, inner: list):
         if isinstance(value, ObjectRef):
             return ("ref", value.id())
-        blob = self._serialize_value(value).to_bytes()
+        ser, refs = serialization.serialize_with_refs(value)
+        blob = ser.to_bytes()
         if len(blob) > config.inline_object_max_bytes:
-            ref = self.put(value)
+            ref = self.put(value)  # put() re-collects and pins via contains
             return ("ref", ref.id())
+        inner.extend(refs)
         return ("v", blob)
 
     def register_function(self, callable_obj) -> Tuple[FunctionID, Optional[bytes]]:
@@ -221,23 +227,26 @@ class Worker:
     def put(self, value) -> ObjectRef:
         flush_pending_releases()  # free before allocating under pressure
         oid = put_counter.next_object_id()
-        ser = self._serialize_value(value)
+        ser, inner = serialization.serialize_with_refs(value)
         size = ser.total_bytes()
         if size <= config.inline_object_max_bytes or self.store is None:
             blob = ser.to_bytes()
             if self.mode == DRIVER:
-                self.raylet.call_async(self.raylet._object_inline, oid, blob)
+                self.raylet.call_async(self.raylet._object_inline, oid, blob,
+                                       inner)
             else:
-                self._request("put_inline", id=oid.hex(), blob=blob)
+                self._request("put_inline", id=oid.hex(), blob=blob,
+                              contains=inner)
         else:
             self.store.put_serialized(oid, ser)
             if self.mode == DRIVER:
-                def _mark(o=oid, n=size):
+                def _mark(o=oid, n=size, inner=inner):
                     self.raylet._obj(o).size = n
-                    self.raylet._object_in_store(o)
+                    self.raylet._object_in_store(o, contains=inner)
                 self.raylet.call_async(_mark)
             else:
-                self._request("register_stored", id=oid.hex(), size=size)
+                self._request("register_stored", id=oid.hex(), size=size,
+                              contains=inner)
         return ObjectRef(oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
@@ -353,7 +362,7 @@ class Worker:
         if self.mode == DRIVER:
             def _free():
                 for h in hexes:
-                    self.raylet._objects.pop(ObjectID.from_hex(h), None)
+                    self.raylet.drop_object(ObjectID.from_hex(h))
             self.raylet.call_async(_free)
         else:
             self._request("free", ids=hexes)
@@ -518,6 +527,16 @@ class DriverWorker(Worker):
             n = min(int(total["CPU"]), 4)
             for _ in range(n):
                 self.raylet.call_async(self.raylet._spawn_worker, "cpu")
+
+        # Periodic ref-event flush: the batching threshold (8) can leave a
+        # tail of release events unsent forever on an idle driver, pinning
+        # their objects; a 0.5s raylet timer drains them.
+        def _ref_flush_tick():
+            flush_pending_releases()
+            self.raylet.add_timer(0.5, _ref_flush_tick)
+
+        self.raylet.call_async(
+            lambda: self.raylet.add_timer(0.5, _ref_flush_tick))
         # Clean up the shm store even if the user forgets shutdown() or the
         # driver exits on an exception.
         import atexit

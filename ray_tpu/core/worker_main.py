@@ -103,6 +103,12 @@ class RemoteWorker(Worker):
         """Send a task-completion message, coalescing with neighbors while
         batched work is still queued locally (flushed at queue drain,
         before any blocking request, or by the ~2ms background flusher)."""
+        # Hold announcements for refs this task deserialized must reach the
+        # raylet BEFORE the done (which releases the spec's borrow pins) —
+        # the socket preserves order, so flushing them first suffices.
+        from ray_tpu.core.worker import flush_pending_releases
+
+        flush_pending_releases()
         with self._done_lock:
             self._done_buf.append(msg)
             if not self.task_queue.empty():
@@ -147,11 +153,13 @@ class RemoteWorker(Worker):
         self._send({"t": "request", "rid": rid, "op": op, **fields})
         remaining = _wait_timeout
         if (op in ("get", "wait", "stream_next")
+                and (remaining is None or remaining > 0.05)
                 and not self.task_queue.empty()):
-            # Grace period before handing batched tasks back: a get that
-            # the raylet satisfies immediately must not trigger a
-            # requeue/redispatch churn cycle.  Only an ACTUALLY-blocking
-            # request gives the queue back.
+            # Grace period before handing batched tasks back: a get the
+            # raylet satisfies immediately must not trigger a
+            # requeue/redispatch churn cycle, and short-timeout POLLS
+            # (wait(timeout=0) loops) never give the queue back at all —
+            # only an actually-blocking request does.
             grace = 0.01 if remaining is None else min(0.01, remaining)
             if entry["event"].wait(grace):
                 remaining = 0
@@ -217,8 +225,13 @@ def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
                 f"but returned {len(values)} values"
             )
     sizes: Dict[str, int] = {}
+    contains: Dict[str, list] = {}
     for oid, val in zip(spec.return_ids(), values):
-        ser = serialization.serialize(val)
+        ser, inner = serialization.serialize_with_refs(val)
+        if inner:
+            # refs inside the result: the raylet pins them for the result
+            # object's lifetime (borrow pinning)
+            contains[oid.hex()] = inner
         n = ser.total_bytes()
         if n <= config.inline_object_max_bytes or worker.store is None:
             inline[oid.hex()] = ser.to_bytes()
@@ -226,7 +239,7 @@ def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
             worker.store.put_serialized(oid, ser)
             stored.append(oid.hex())
             sizes[oid.hex()] = n
-    return inline, stored, sizes
+    return inline, stored, sizes, contains
 
 
 def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
@@ -237,15 +250,15 @@ def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
     idx = 0
     for item in gen:
         oid = spec.stream_item_id(idx)
-        ser = serialization.serialize(item)
+        ser, inner = serialization.serialize_with_refs(item)
         n = ser.total_bytes()
         if n <= config.inline_object_max_bytes or worker.store is None:
             worker._send({"t": "stream_item", "id": oid.hex(), "index": idx,
-                          "inline": ser.to_bytes()})
+                          "inline": ser.to_bytes(), "contains": inner})
         else:
             worker.store.put_serialized(oid, ser)
             worker._send({"t": "stream_item", "id": oid.hex(), "index": idx,
-                          "inline": None, "size": n})
+                          "inline": None, "size": n, "contains": inner})
         idx += 1
     return idx
 
@@ -339,9 +352,11 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
         result = await getattr(worker.actor_instance, spec.method_name)(
             *args, **kwargs
         )
-        inline, stored, sizes = _package_results(worker, spec, result)
+        inline, stored, sizes, contains = _package_results(worker, spec,
+                                                            result)
         worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
-                          "inline": inline, "stored": stored, "sizes": sizes})
+                          "inline": inline, "stored": stored, "sizes": sizes,
+                          "contains": contains})
         return True
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -408,10 +423,11 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
             result = fn(*args, **kwargs)
         if spec.num_returns == STREAMING_RETURNS:
             result = _run_streaming(worker, spec, result)
-        inline, stored, sizes = _package_results(worker, spec, result)
+        inline, stored, sizes, contains = _package_results(worker, spec,
+                                                            result)
         worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
                           "inline": inline, "stored": stored, "sizes": sizes,
-                          **extra})
+                          "contains": contains, **extra})
         return True
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()

@@ -211,7 +211,7 @@ def test_batched_dispatch_preserves_fanout_parallelism(ray_shared):
     def sleeper():
         import time
 
-        time.sleep(0.4)
+        time.sleep(0.8)
         return 1
 
     # warm the pool so all 4 workers exist
@@ -220,8 +220,9 @@ def test_batched_dispatch_preserves_fanout_parallelism(ray_shared):
     assert sum(ray_shared.get([sleeper.remote() for _ in range(4)],
                               timeout=30)) == 4
     took = _time.perf_counter() - t0
-    # parallel: ~0.4s (+overhead); serialized-on-one-worker would be 1.6s+
-    assert took < 1.2, f"fan-out took {took:.2f}s — batching serialized it?"
+    # parallel: ~0.8s (+overhead); serialized-on-one-worker would be 3.2s+
+    # (threshold leaves headroom for contended-host scheduling noise)
+    assert took < 2.4, f"fan-out took {took:.2f}s — batching serialized it?"
 
 
 def test_blocked_batch_member_requeues_followers(ray_shared):

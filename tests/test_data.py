@@ -193,6 +193,14 @@ def test_streaming_is_parallel(ray):
     """Blocks must execute concurrently (not serially) through the
     streaming executor."""
 
+    @ray.remote
+    def _warm():
+        return 0
+
+    # worker spawn costs ~2.3s of jax import apiece on a 1-vCPU host —
+    # warm the pool so the assertion measures scheduling, not cold start
+    ray.get([_warm.remote() for _ in range(8)], timeout=60)
+
     def slow(b):
         time.sleep(0.4)
         return b

@@ -126,3 +126,95 @@ def test_held_task_result_survives_pressure(small_store):
         ray_tpu.get(churn.remote(i), timeout=60)
     got = ray_tpu.get(ref, timeout=60)
     assert got.shape == (1 << 20,)
+
+
+@pytest.fixture
+def fast_grace():
+    """0.1s free grace: any surviving correctness must come from borrow
+    pinning, not the grace window."""
+    from ray_tpu.core.config import config
+
+    old = config.ref_free_grace_s
+    config.ref_free_grace_s = 0.1
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    config.ref_free_grace_s = old
+
+
+def test_ref_inside_put_object_survives_stall(fast_grace):
+    """A ref serialized INSIDE a put() object must stay alive while the
+    outer object exists, however long it sits unread (borrow pinning —
+    reference: reference_count.h:233); the 0.1s grace alone cannot save
+    it through a 1s stall."""
+    inner = ray_tpu.put(np.arange(1024))
+    outer = ray_tpu.put({"wrapped": [inner]})
+    del inner  # only the serialized bytes inside `outer` mention it now
+    gc.collect()
+    time.sleep(1.0)  # >> grace: an unpinned inner would be freed here
+    got = ray_tpu.get(outer)["wrapped"][0]
+    assert int(ray_tpu.get(got)[100]) == 100
+
+
+def test_ref_inside_task_result_survives_stall(fast_grace):
+    """A task returning a ref it created: the result object pins the inner
+    ref until the result itself is released."""
+
+    @ray_tpu.remote
+    def make():
+        r = ray_tpu.put(np.full(512, 7))
+        return {"ref": r}
+
+    res = make.remote()
+    time.sleep(1.0)  # result sits unread well past the grace window
+    wrapped = ray_tpu.get(res)["ref"]
+    del res
+    gc.collect()
+    time.sleep(0.5)
+    assert int(ray_tpu.get(wrapped)[0]) == 7
+
+
+def test_ref_inside_arg_value_survives_stall(fast_grace):
+    """A ref smuggled inside an inline arg VALUE (not a declared dep) is
+    pinned by the spec until the task completes — even if the task
+    deserializes it late."""
+    inner = ray_tpu.put(np.full(256, 3))
+
+    @ray_tpu.remote
+    def late_reader(wrapped):
+        import time as _t
+
+        _t.sleep(1.0)  # spec pins the inner ref through the stall
+        return int(ray_tpu.get(wrapped[0])[0])
+
+    ref = late_reader.remote([inner])
+    del inner
+    gc.collect()
+    assert ray_tpu.get(ref, timeout=60) == 3
+
+
+def test_inner_ref_freed_after_outer_released(fast_grace):
+    """Pinning must not leak: once the outer object AND all refs are gone,
+    the inner entry is freed from raylet metadata."""
+    from ray_tpu.core.ids import ObjectID
+
+    inner = ray_tpu.put(np.arange(64))
+    inner_id = inner.id()
+    outer = ray_tpu.put([inner])
+    del inner
+    gc.collect()
+    time.sleep(0.5)
+    w = global_worker()
+    # still alive: pinned by outer's bytes
+    assert w.raylet.call(
+        lambda: inner_id in w.raylet._objects).result()
+    del outer
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not w.raylet.call(
+                lambda: inner_id in w.raylet._objects).result():
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("inner entry never freed after outer released")
