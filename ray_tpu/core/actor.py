@@ -212,6 +212,12 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
             lambda: raylet.gcs.lookup_named_actor(namespace, name)).result()
         if info is None:
             raise ValueError(f"no actor named {name!r}")
+        if info.get("state") == "dead":
+            from ray_tpu.core.exceptions import ActorDiedError
+
+            raise ActorDiedError(
+                info["actor_id"].hex(),
+                info.get("death_reason", "actor is dead"))
         aid = ActorID(info["actor_id"])
         if info.get("spec_blob"):
             import cloudpickle as _cp

@@ -39,6 +39,10 @@ from ray_tpu.core.config import config
 
 config.define("gcs_heartbeat_interval_s", float, 0.25,
               "Raylet -> GCS resource heartbeat period.")
+config.define("gcs_restart_reconcile_s", float, 5.0,
+              "After a GCS restart, how long raylets get to reconnect "
+              "before actors/PG bundles referencing never-returning nodes "
+              "are reconciled (actors -> dead, bundles -> re-placed).")
 config.define("gcs_node_timeout_s", float, 3.0,
               "Heartbeat silence after which a node is declared dead "
               "(reference: health check manager timeouts).")
@@ -84,6 +88,8 @@ class GcsCore:
         self._subs: List[Tuple[Optional[str], Callable[[str, Any], None]]] = []
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._restored = False  # snapshot loaded => this is a restart
+        self._kv_soft_ts: Dict[Tuple[str, bytes], float] = {}
         if persist_path:
             self._load_snapshot()
             self._start_flusher()
@@ -110,10 +116,13 @@ class GcsCore:
             self._cluster_pgs = snap.get("cluster_pgs", {})
             # Actors whose host nodes are gone (nodes are soft state) are
             # surfaced as restarting; their home raylet reconciles on
-            # reconnect.
+            # reconnect.  start_restart_reconciler() handles the raylets
+            # that never come back.
             for info in self._actors.values():
                 if info.get("state") == "alive":
                     info["state"] = "restarting"
+            self._restored = bool(self._actors or self._kv
+                                  or self._cluster_pgs)
 
     def _write_snapshot(self):
         import pickle
@@ -130,7 +139,8 @@ class GcsCore:
             # re-sets it so acknowledged state is never silently dropped.
             with self._lock:
                 tables = {
-                    "kv": dict(self._kv),
+                    "kv": {k: v for k, v in self._kv.items()
+                           if k[0] not in self._SOFT_KV_NS},
                     "functions": dict(self._functions),
                     "actors": {k: dict(v) for k, v in self._actors.items()},
                     "named": dict(self._named),
@@ -332,12 +342,70 @@ class GcsCore:
                               {"pg_id": pg_id, "bundles": sub},
                               target_node=node)
 
+    def start_restart_reconciler(self, delay: Optional[float] = None):
+        """Post-restart sweep for raylets that never reconnect.
+
+        Snapshot-reloaded actors come back as 'restarting' on the theory
+        that their home raylet will re-assert them — but a raylet that
+        died DURING the GCS outage never re-registers and (the node table
+        being soft state) never produces a node-death event either, so
+        those actors would stay 'restarting' forever and named-actor
+        callers would hang.  Once the reconnect window elapses: actors
+        whose owner node never returned go to 'dead' (lookups then raise
+        instead of hanging), and cluster-PG bundles assigned to ghost
+        nodes are re-placed through the normal dead-node repair path.  A
+        slow-but-alive raylet that reconnects later simply re-asserts its
+        actors back to 'alive' — the sweep is recoverable, not fatal."""
+        if not self._restored:
+            return
+        if delay is None:
+            delay = config.gcs_restart_reconcile_s
+
+        def run():
+            if self._stop.wait(delay):
+                return
+            with self._lock:
+                live = {nid for nid, i in self._nodes.items() if i["alive"]}
+                ghost_actors = [
+                    aid for aid, i in self._actors.items()
+                    if i.get("state") in ("restarting", "pending")
+                    and i.get("owner_node") not in live
+                ]
+                ghost_nodes = set()
+                for entry in self._cluster_pgs.values():
+                    ghost_nodes.update(
+                        n for n in entry["assignments"].values()
+                        if n not in live)
+                    ghost_nodes.update(
+                        n for n in entry["pending"] if n not in live)
+            for aid in ghost_actors:
+                with self._lock:
+                    info = self._actors.get(aid)
+                    # re-check: the owner may have reconnected since
+                    if (info is None
+                            or info.get("state") not in ("restarting",
+                                                         "pending")
+                            or info.get("owner_node") in {
+                                nid for nid, i in self._nodes.items()
+                                if i["alive"]}):
+                        continue
+                    info["state"] = "dead"
+                    info["death_reason"] = (
+                        "owner raylet never reconnected after GCS restart")
+                    self._mark_dirty()
+            for nid in ghost_nodes:
+                self._repair_pgs_for_dead_node(nid)
+
+        threading.Thread(target=run, name="gcs-restart-reconcile",
+                         daemon=True).start()
+
     def start_health_monitor(self):
         if self._monitor is not None:
             return
 
         def loop():
             period = max(0.05, config.gcs_heartbeat_interval_s / 2)
+            soft_sweep_at = time.monotonic() + self._SOFT_KV_TTL_S
             while not self._stop.wait(period):
                 timeout = config.gcs_node_timeout_s
                 now = time.monotonic()
@@ -349,6 +417,16 @@ class GcsCore:
                     ]
                 for nid in stale:
                     self._mark_dead(nid, "missed heartbeats")
+                if now >= soft_sweep_at:
+                    # TTL sweep of soft KV (dead metric producers)
+                    soft_sweep_at = now + self._SOFT_KV_TTL_S
+                    with self._lock:
+                        dead_keys = [
+                            k for k, ts in self._kv_soft_ts.items()
+                            if now - ts > self._SOFT_KV_TTL_S]
+                        for k in dead_keys:
+                            self._kv_soft_ts.pop(k, None)
+                            self._kv.pop(k, None)
 
         self._monitor = threading.Thread(target=loop, name="gcs-health",
                                          daemon=True)
@@ -545,10 +623,22 @@ class GcsCore:
 
     # ----------------------------------------------------------- kv
 
+    # Soft-state KV namespaces: high-churn, rebuildable data (per-producer
+    # metric samples flush ~1/s forever) — excluded from the durable
+    # snapshot (else every flush rewrites it) and TTL-swept so dead
+    # producers' keys don't accumulate.  Job logs stay durable by design
+    # (documented: they outlive client and driver) — they are per-job
+    # bounded, not per-second unbounded.
+    _SOFT_KV_NS = frozenset({"metrics"})
+    _SOFT_KV_TTL_S = 120.0
+
     def kv_put(self, ns: str, key: bytes, val: bytes):
         with self._lock:
             self._kv[(ns, key)] = val
-            self._mark_dirty()
+            if ns in self._SOFT_KV_NS:
+                self._kv_soft_ts[(ns, key)] = time.monotonic()
+            else:
+                self._mark_dirty()
 
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         with self._lock:
@@ -557,7 +647,8 @@ class GcsCore:
     def kv_del(self, ns: str, key: bytes) -> bool:
         with self._lock:
             existed = self._kv.pop((ns, key), None) is not None
-            if existed:
+            self._kv_soft_ts.pop((ns, key), None)
+            if existed and ns not in self._SOFT_KV_NS:
                 self._mark_dirty()
             return existed
 
@@ -731,6 +822,7 @@ class GcsServer:
         self._conns: List[socket.socket] = []
         self._stop = False
         self.core.start_health_monitor()
+        self.core.start_restart_reconciler()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="gcs-accept", daemon=True)
         self._accept_thread.start()

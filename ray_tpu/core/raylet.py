@@ -1754,8 +1754,13 @@ class Raylet:
         """Explicit user free: remove the entry now, releasing any borrow
         pins its bytes held on inner refs."""
         st = self._objects.pop(oid, None)
-        if st is None:
-            return
+        if st is not None:
+            self._teardown_entry(oid, st)
+
+    def _teardown_entry(self, oid: ObjectID, st: "_ObjectState"):
+        """Shared final teardown for a removed object entry (explicit free
+        and auto-free): lineage accounting, store bytes, borrow-pin
+        release, location directory."""
         if st.creating_spec is not None:
             self._lineage_count -= 1
         if st.status == "store":
@@ -1766,6 +1771,8 @@ class Raylet:
                 except Exception:  # noqa: BLE001
                     pass
         if st.contains:
+            # this blob's inner refs lose their borrow pins; they free in
+            # turn once nothing else holds them
             for inner in st.contains:
                 inner_st = self._objects.get(inner)
                 if inner_st is not None:
@@ -1799,25 +1806,7 @@ class Raylet:
                 or oid in self._dep_index or oid in self._object_waiters):
             return
         del self._objects[oid]
-        if st.creating_spec is not None:
-            self._lineage_count -= 1
-        if st.status == "store":
-            store = self._raylet_store()
-            if store is not None:
-                try:
-                    store.delete(oid)
-                except Exception:  # noqa: BLE001
-                    pass
-        if st.contains:
-            # this blob's inner refs lose their borrow pins; they free in
-            # turn once nothing else holds them
-            for inner in st.contains:
-                inner_st = self._objects.get(inner)
-                if inner_st is not None:
-                    inner_st.pins -= 1
-                    self._maybe_free(inner)
-        if self.cluster_mode:
-            self._gcs_post("remove_object_location", oid.hex(), self.node_id)
+        self._teardown_entry(oid, st)
 
     def _pin_deps(self, spec: TaskSpec):
         """Pin dependency objects — declared top-level deps AND refs
@@ -2030,6 +2019,7 @@ class Raylet:
                 inner_st = self._objects.get(inner)
                 if inner_st is not None:
                     inner_st.pins -= 1
+                    self._maybe_free(inner)
         st.contains = list(contains)
         for inner in st.contains:
             self._obj(inner).pins += 1
@@ -2946,6 +2936,10 @@ class Raylet:
                 if info is None:
                     reply(ok=False, error=ValueError(
                         f"no actor named {msg['name']!r}"))
+                elif info.get("state") == "dead":
+                    reply(ok=False, error=ActorDiedError(
+                        info["actor_id"].hex(),
+                        info.get("death_reason", "actor is dead")))
                 else:
                     import cloudpickle as _cp
 

@@ -171,12 +171,27 @@ class Worker:
         pushed to the GCS function table once (reference function_manager).
 
         Per-object memo: re-pickling the same function on EVERY .remote()
-        was ~13% of async submission cost (profiled); identity-keyed is
-        correct because a mutated-then-resubmitted function is a new code
-        object in practice (and the reference's function manager keys by
-        function identity the same way)."""
+        was ~13% of async submission cost (profiled); identity-keyed, with
+        a mutation fingerprint holding STRONG REFS to the attribute dict's
+        values, __defaults__ and __code__ and comparing by identity — so
+        rebinding a function attribute or its defaults re-pickles instead
+        of silently shipping the old state (and the kept refs make the
+        `is` checks immune to id reuse).  In-place mutation of a captured
+        object's internals remains export-once, matching the reference's
+        function manager semantics."""
+        fp = (dict(getattr(callable_obj, "__dict__", None) or {}),
+              getattr(callable_obj, "__defaults__", None),
+              getattr(callable_obj, "__code__", None))
+
+        def _fp_same(a, b):
+            da, db = a[0], b[0]
+            return (a[1] is b[1] and a[2] is b[2]
+                    and da.keys() == db.keys()
+                    and all(da[k] is db[k] for k in da))
+
         memo = self._fn_memo.get(id(callable_obj))
-        if memo is not None and memo[0] is callable_obj:
+        if (memo is not None and memo[0] is callable_obj
+                and _fp_same(memo[3], fp)):
             self._fn_memo.move_to_end(id(callable_obj))
             return memo[1], memo[2]
         blob = cloudpickle.dumps(callable_obj)
@@ -189,7 +204,7 @@ class Worker:
                 self._pushed_functions.add(fid)
             out = (fid, None)
         # keep a strong ref to the callable so id() stays unambiguous
-        self._fn_memo[id(callable_obj)] = (callable_obj, out[0], out[1])
+        self._fn_memo[id(callable_obj)] = (callable_obj, out[0], out[1], fp)
         while len(self._fn_memo) > 256:
             self._fn_memo.popitem(last=False)
         return out

@@ -118,6 +118,10 @@ def _routing_for(deployment: str) -> _DeploymentRouting:
 _model_affinity: "OrderedDict" = OrderedDict()
 _model_affinity_lock = threading.Lock()
 _MODEL_AFFINITY_CAP = 4096
+#: replica -> (queue_len, ts): short-TTL cache of the affinity probe so the
+#: multiplexed hot path doesn't pay a round trip per request
+_affinity_probe_cache: "OrderedDict" = OrderedDict()
+_AFFINITY_PROBE_TTL_S = 1.0
 
 
 def _prune_affinity(deployment: str):
@@ -193,7 +197,14 @@ class DeploymentHandle:
     def _pick_replica_affine(self):
         """Model affinity: prefer the replica that last served this model
         (it has the model in its LRU) unless it is heavily loaded relative
-        to a power-of-two alternative."""
+        to a power-of-two alternative.
+
+        The affinity probe is cached (~1s TTL) and short-timeout: the
+        reference pushes loaded-model ids to the router instead of probing,
+        so a per-request synchronous 5s probe on the hot path — blocking
+        a full 5s whenever the cached replica just died — was the wrong
+        trade.  A stale-but-fresh queue length only risks a slightly
+        suboptimal pick; a dead replica costs at most 0.5s once per TTL."""
         import ray_tpu
 
         key = (self._deployment, self._model_id)
@@ -206,12 +217,25 @@ class DeploymentHandle:
         with routing.lock:
             alive = set(routing.replicas)
         if cached is not None and cached in alive:
-            try:
-                q = ray_tpu.get(cached.get_queue_len.remote(), timeout=5.0)
-                if q <= 4:  # loaded-model locality beats a cold load
+            now = time.time()
+            with _model_affinity_lock:
+                probe = _affinity_probe_cache.get(cached)
+            if probe is not None and now - probe[1] < _AFFINITY_PROBE_TTL_S:
+                if probe[0] <= 4:
                     return cached
-            except Exception:  # noqa: BLE001 — replica gone
-                pass
+            else:
+                try:
+                    q = ray_tpu.get(cached.get_queue_len.remote(),
+                                    timeout=0.5)
+                    with _model_affinity_lock:
+                        _affinity_probe_cache[cached] = (q, now)
+                        while len(_affinity_probe_cache) > \
+                                _MODEL_AFFINITY_CAP:
+                            _affinity_probe_cache.popitem(last=False)
+                    if q <= 4:  # loaded-model locality beats a cold load
+                        return cached
+                except Exception:  # noqa: BLE001 — replica gone
+                    pass
         replica = self._pick_replica()
         with _model_affinity_lock:
             _model_affinity[key] = replica

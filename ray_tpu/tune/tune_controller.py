@@ -169,11 +169,25 @@ class TuneController:
             n_active += 1
 
     def run(self) -> List[Trial]:
-        # Searcher path: the budget (num_samples) bounds concurrency, same
-        # default as the pre-materialized path (all trials in parallel);
-        # sequential bayesian search is max_concurrent_trials=1.
-        default_conc = (self._search_budget if self.searcher is not None
-                        else len(self.trials))
+        # Concurrency defaults: the pre-materialized path runs all trials
+        # in parallel, but a model-based searcher with unbounded
+        # concurrency degenerates to random sampling (every suggestion is
+        # made before any result lands, so the model never sees history).
+        # Default the searcher path to its warmup width (n_initial_points,
+        # else 8) — the random phase parallelizes freely, then suggestions
+        # serialize enough for the model to learn.  max_concurrent_trials
+        # overrides either way; sequential bayesian search is
+        # max_concurrent_trials=1.
+        warmup = (getattr(self.searcher, "n_initial", None)
+                  if self.searcher is not None else None)
+        if warmup:
+            # model-based searcher (has a warmup phase): cap concurrency
+            default_conc = min(self._search_budget, warmup)
+        elif self.searcher is not None:
+            # non-model searcher (random/grid): full-budget parallelism
+            default_conc = self._search_budget
+        else:
+            default_conc = len(self.trials)
         max_conc = self.tc.max_concurrent_trials or max(default_conc, 1)
         start_time = time.monotonic()
         while True:

@@ -94,3 +94,64 @@ def test_gcs_restart_without_persistence_kills_nodes(tmp_path):
         assert not head.alive()
     finally:
         c.shutdown()
+
+
+def test_restart_reconciler_buries_ghost_actors(tmp_path):
+    """GCS restart where an actor's home raylet died during the outage:
+    the reconciliation sweep marks the actor dead (named lookups raise
+    ActorDiedError instead of hanging) and re-places PG bundles assigned
+    to the ghost node (ADVICE r4: unreconciled corner)."""
+    from ray_tpu.core.gcs import GcsCore
+
+    path = str(tmp_path / "gcs.snap")
+    g1 = GcsCore(persist_path=path)
+    g1.register_node("ghost", ("127.0.0.1", 1), {"CPU": 2.0})
+    g1.register_node("alive", ("127.0.0.1", 2), {"CPU": 2.0})
+    g1.register_actor(b"actor-1", "ghost", name="counter", namespace="")
+    g1.update_actor(b"actor-1", "alive", node_id="ghost")
+    g1.create_pg("pg1", [{"CPU": 1.0}, {"CPU": 1.0}], "SPREAD", "ghost")
+    g1.stop()
+
+    # restart: only the "alive" raylet comes back
+    g2 = GcsCore(persist_path=path)
+    assert g2.get_actor(b"actor-1")["state"] == "restarting"
+    g2.register_node("alive", ("127.0.0.1", 2), {"CPU": 2.0})
+    g2.start_restart_reconciler(delay=0.3)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if g2.get_actor(b"actor-1")["state"] == "dead":
+            break
+        time.sleep(0.1)
+    info = g2.get_actor(b"actor-1")
+    assert info["state"] == "dead"
+    assert "never reconnected" in info.get("death_reason", "")
+    # named lookup surfaces the death state for callers to raise on
+    assert g2.lookup_named_actor("", "counter")["state"] == "dead"
+    # any PG bundles assigned to the ghost node are no longer on it
+    pg = g2.pg_info("pg1")
+    if pg is not None:
+        assert "ghost" not in set(pg["assignments"].values())
+    g2.stop()
+
+
+def test_metrics_namespace_is_soft_state(tmp_path):
+    """Metric flushes must not mark the durable snapshot dirty (they
+    previously rewrote it ~1/s forever) and stale producer keys TTL out."""
+    from ray_tpu.core.gcs import GcsCore
+
+    path = str(tmp_path / "gcs.snap")
+    g = GcsCore(persist_path=path)
+    g.kv_put("jobs", b"j1", b"info")       # durable
+    # wait for flusher to settle
+    deadline = time.monotonic() + 5
+    while g._dirty and time.monotonic() < deadline:
+        time.sleep(0.05)
+    g.kv_put("metrics", b"pid-1/m", b"{}")  # soft
+    assert not g._dirty, "metrics put must not dirty the snapshot"
+    assert g.kv_get("metrics", b"pid-1/m") == b"{}"
+    g.stop()
+    # restart: durable survived, soft did not
+    g2 = GcsCore(persist_path=path)
+    assert g2.kv_get("jobs", b"j1") == b"info"
+    assert g2.kv_get("metrics", b"pid-1/m") is None
+    g2.stop()
