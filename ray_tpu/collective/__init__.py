@@ -107,6 +107,21 @@ class _HostGroup:
         self._kv().kv_put(key.encode(), pickle.dumps(value),
                           namespace="collective")
 
+    def _del(self, key: str):
+        try:
+            self._kv().kv_del(key.encode(), namespace="collective")
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
+
+    def _gc_round(self, kind: str):
+        """Delete THIS rank's key from two rounds ago: every rank has
+        finished reading round seq-1 before any rank can enter seq+1 (the
+        gather blocks on all ranks' seq keys), so seq-2 keys are dead.
+        Without this, hot-path collectives (gradient allreduce per update)
+        accumulate world_size x payload in the KV forever."""
+        if self._seq > 2:
+            self._del(f"{self.name}/{kind}{self._seq - 2}/{self.rank}")
+
     def _get(self, key: str, timeout: float = 120.0):
         w = self._kv()
         deadline = time.monotonic() + timeout
@@ -122,12 +137,14 @@ class _HostGroup:
 
     def barrier(self, timeout: float = 120.0):
         self._seq += 1
+        self._gc_round("bar")
         self._put(f"{self.name}/bar{self._seq}/{self.rank}", True)
         for r in range(self.world_size):
             self._get(f"{self.name}/bar{self._seq}/{r}", timeout)
 
     def allgather_obj(self, obj: Any, timeout: float = 120.0) -> List[Any]:
         self._seq += 1
+        self._gc_round("ag")
         self._put(f"{self.name}/ag{self._seq}/{self.rank}", obj)
         return [self._get(f"{self.name}/ag{self._seq}/{r}", timeout)
                 for r in range(self.world_size)]
@@ -146,6 +163,9 @@ class _HostGroup:
         raise ValueError(f"unknown op {op}")
 
     def broadcast(self, arr, root: int = 0, timeout: float = 120.0):
+        # NOTE: no _gc_round here — broadcast doesn't block the root on
+        # readers, so an old key may still be in flight; bc keys are
+        # typically few (bootstrap-time) and small.
         self._seq += 1
         if self.rank == root:
             self._put(f"{self.name}/bc{self._seq}", np.asarray(arr))

@@ -24,6 +24,14 @@ from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, make_vtrace_fn
+from ray_tpu.rllib.learner_group import LearnerGroup, LearnerWorker
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.sac import SAC, SACConfig, sac_action_fn
 from ray_tpu.rllib.replay_buffers import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
@@ -42,8 +50,12 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 __all__ = [
     "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "DQN", "DQNConfig",
     "EnvRunner",
-    "Impala", "ImpalaConfig", "PPO", "PPOConfig",
-    "PrioritizedReplayBuffer", "ReplayBuffer", "SampleBatch",
+    "Impala", "ImpalaConfig", "LearnerGroup", "LearnerWorker",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+    "MultiAgentPPOConfig", "PPO", "PPOConfig",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "SAC", "SACConfig",
+    "SampleBatch",
     "compute_gae", "cnn_forward", "init_cnn_policy", "init_mlp_policy",
-    "make_vtrace_fn", "mlp_forward", "policy_forward", "sample_action",
+    "make_vtrace_fn", "mlp_forward", "policy_forward", "sac_action_fn",
+    "sample_action",
 ]

@@ -80,7 +80,6 @@ class Algorithm(Trainable):
 
     def setup(self, config: Dict[str, Any]):
         import ray_tpu
-        from ray_tpu.rllib.env_runner import EnvRunner
 
         cfg = self._algo_config
         if cfg is None:
@@ -97,10 +96,9 @@ class Algorithm(Trainable):
         runner_cls = ray_tpu.remote(
             num_cpus=res.get("CPU", 1), max_restarts=1,
             runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}},
-        )(EnvRunner)
+        )(self.runner_class())
         self.env_runners = [
-            runner_cls.remote(cfg.env_creator, cfg.num_envs_per_runner,
-                              cfg.rollout_length, None, seed=cfg.seed + i,
+            runner_cls.remote(*self.runner_args(cfg, i),
                               **self.runner_kwargs())
             for i in range(cfg.num_env_runners)
         ]
@@ -110,6 +108,17 @@ class Algorithm(Trainable):
         self.sync_weights()
 
     # ---- override points -----------------------------------------------
+
+    def runner_class(self):
+        """The rollout-actor class (multi-agent algorithms override)."""
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        return EnvRunner
+
+    def runner_args(self, cfg, i: int) -> tuple:
+        """Positional args for the i-th runner actor."""
+        return (cfg.env_creator, cfg.num_envs_per_runner,
+                cfg.rollout_length, None, cfg.seed + i)
 
     def runner_kwargs(self) -> Dict[str, Any]:
         """Extra EnvRunner kwargs (e.g. DQN's epsilon-greedy action_fn)."""

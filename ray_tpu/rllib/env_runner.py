@@ -102,7 +102,9 @@ class EnvRunner:
         assert self._params is not None, "set_weights before sample"
         T, B = self._T, self._num_envs
         obs_buf = np.empty((T, B) + self._obs.shape[1:], self._obs.dtype)
-        act_buf = np.empty((T, B), np.int64)
+        act_buf = None  # allocated from the first action (shape/dtype vary:
+        # int64 (B,) for discrete policies, float32 (B, act_dim) for
+        # continuous ones like SAC's tanh-Gaussian)
         logp_buf = np.empty((T, B), np.float32)
         val_buf = np.empty((T, B), np.float32)
         rew_buf = np.empty((T, B), np.float32)
@@ -118,6 +120,8 @@ class EnvRunner:
             next_obs, reward, terminated, truncated, _ = self._envs.step(
                 action)
             obs_buf[t] = self._obs
+            if act_buf is None:
+                act_buf = np.empty((T,) + action.shape, action.dtype)
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
@@ -152,7 +156,7 @@ class EnvRunner:
             # keep the native obs shape (CNN policies need (H, W, C));
             # MLP forward flattens for itself
             OBS: obs_buf.reshape((T * B,) + obs_buf.shape[2:]),
-            ACTIONS: act_buf.reshape(T * B),
+            ACTIONS: act_buf.reshape((T * B,) + act_buf.shape[2:]),
             LOGPS: logp_buf.reshape(T * B),
             VALUES: val_buf.reshape(T * B),
             REWARDS: rew_buf.reshape(T * B),

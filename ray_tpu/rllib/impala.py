@@ -41,6 +41,10 @@ class ImpalaConfig(AlgorithmConfig):
         self.hidden = (64, 64)
         self.cnn = False  # Nature-CNN torso for (H, W, C) pixel obs
         self.max_inflight_per_runner = 1
+        # >1: data-parallel learner replicas (LearnerGroup) — each update's
+        # batch shards across them and gradients allreduce-average
+        # (reference: `rllib/core/learner/learner_group.py:61`)
+        self.num_learners = 1
 
     def build(self) -> "Impala":
         return Impala(self)
@@ -83,7 +87,7 @@ def make_vtrace_fn():
     return vtrace
 
 
-def _make_update_fn(cfg: ImpalaConfig, optimizer):
+def _make_loss_fn(cfg: ImpalaConfig):
     import jax
     import jax.numpy as jnp
 
@@ -116,8 +120,19 @@ def _make_update_fn(cfg: ImpalaConfig, optimizer):
         return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
                        "entropy": entropy}
 
+    return loss_fn
+
+
+def _make_grad_apply(cfg: ImpalaConfig, optimizer):
+    """(grad_fn, apply_fn) split — the LearnerGroup replicas allreduce
+    between the two; the local path composes them in one call."""
+    import jax
+    import jax.numpy as jnp
+
+    loss_fn = _make_loss_fn(cfg)
+
     @jax.jit
-    def update(params, opt_state, batch):
+    def grad_fn(params, batch):
         (_, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, batch)
         if cfg.grad_clip:
@@ -125,8 +140,42 @@ def _make_update_fn(cfg: ImpalaConfig, optimizer):
                                  for g in jax.tree.leaves(grads)))
             scale = jnp.minimum(1.0, cfg.grad_clip / (gnorm + 1e-8))
             grads = jax.tree.map(lambda g: g * scale, grads)
+        return grads, metrics
+
+    @jax.jit
+    def apply_fn(params, opt_state, grads):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state
+
+    return grad_fn, apply_fn
+
+
+def _init_params_and_opt(cfg: ImpalaConfig, obs_shape, num_actions):
+    """ONE copy of the param/optimizer construction for the local learner
+    AND the LearnerGroup replicas — their lockstep guarantee depends on
+    byte-identical init."""
+    import jax
+    import optax
+
+    from ray_tpu.rllib.models import init_cnn_policy, init_mlp_policy
+
+    key = jax.random.PRNGKey(cfg.seed)
+    if cfg.cnn:
+        params = init_cnn_policy(key, obs_shape, num_actions)
+    else:
+        params = init_mlp_policy(
+            key, int(np.prod(obs_shape)), num_actions, cfg.hidden)
+    optimizer = optax.rmsprop(cfg.lr, decay=0.99, eps=0.1)
+    return params, optimizer, optimizer.init(params)
+
+
+def _make_update_fn(cfg: ImpalaConfig, optimizer):
+    grad_fn, apply_fn = _make_grad_apply(cfg, optimizer)
+
+    def update(params, opt_state, batch):
+        grads, metrics = grad_fn(params, batch)
+        params, opt_state = apply_fn(params, opt_state, grads)
         return params, opt_state, metrics
 
     return update
@@ -136,34 +185,44 @@ class Impala(Algorithm):
     _config_cls = ImpalaConfig
 
     def build_learner(self):
-        import jax
-        import optax
-
-        from ray_tpu.rllib.models import init_cnn_policy, init_mlp_policy
-
         cfg: ImpalaConfig = self.algo_config
         probe_env = cfg.env_creator()
         num_actions = int(probe_env.action_space.n)
         obs_shape = probe_env.observation_space.shape
         probe_env.close()
-        key = jax.random.PRNGKey(cfg.seed)
-        if cfg.cnn:
-            self._params = init_cnn_policy(key, obs_shape, num_actions)
-        else:
-            self._params = init_mlp_policy(
-                key, int(np.prod(obs_shape)), num_actions, cfg.hidden)
-        self._optimizer = optax.rmsprop(cfg.lr, decay=0.99, eps=0.1)
-        self._opt_state = self._optimizer.init(self._params)
+        self._params, self._optimizer, self._opt_state = \
+            _init_params_and_opt(cfg, obs_shape, num_actions)
         self._update = _make_update_fn(cfg, self._optimizer)
+        self._learner_group = None
+        if cfg.num_learners > 1:
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            # the replicas run the SAME init (same seed/optimizer) so they
+            # start in lockstep with the single-learner path
+            def factory(cfg=cfg, obs_shape=obs_shape,
+                        num_actions=num_actions):
+                params, opt, opt_state = _init_params_and_opt(
+                    cfg, obs_shape, num_actions)
+                grad_fn, apply_fn = _make_grad_apply(cfg, opt)
+                return {"params": params, "opt_state": opt_state,
+                        "grad_fn": grad_fn, "apply_fn": apply_fn}
+
+            self._learner_group = LearnerGroup(factory, cfg.num_learners)
         self._inflight: Dict[Any, Any] = {}  # ref -> runner
 
     def get_weights(self):
         import jax
 
+        if self._learner_group is not None:
+            return self._learner_group.get_weights()
         return jax.tree.map(np.asarray, self._params)
 
     def set_weights(self, weights):
         self._params = weights
+        if self._learner_group is not None:
+            # checkpoint restore must reach the replicas, not just the
+            # (unused-under-fanout) local copy
+            self._learner_group.set_weights(weights)
 
     def _ensure_sampling(self):
         """Keep every runner busy (the async pipeline of the reference's
@@ -204,8 +263,15 @@ class Impala(Algorithm):
                 DONES: b[DONES].reshape(T, B).astype(np.float32),
                 "bootstrap": ro["last_values"].astype(np.float32),
             }
-            self._params, self._opt_state, m = self._update(
-                self._params, self._opt_state, tm)
+            if self._learner_group is not None:
+                # time-major arrays shard on the env axis (1); bootstrap
+                # values are (B,) and shard on 0
+                m = self._learner_group.update(
+                    tm, axis_map={OBS: 1, ACTIONS: 1, LOGPS: 1,
+                                  REWARDS: 1, DONES: 1, "bootstrap": 0})
+            else:
+                self._params, self._opt_state, m = self._update(
+                    self._params, self._opt_state, tm)
             metrics = {k: float(v) for k, v in m.items()}
             steps += T * B
             # restart sampling on the freed runner with FRESH weights
@@ -214,6 +280,11 @@ class Impala(Algorithm):
         metrics["_steps_this_iter"] = steps
         metrics["num_inflight"] = len(self._inflight)
         return metrics
+
+    def cleanup(self):
+        if self._learner_group is not None:
+            self._learner_group.shutdown()
+        super().cleanup()
 
     def synchronous_parallel_sample(self):  # not used by IMPALA
         raise NotImplementedError

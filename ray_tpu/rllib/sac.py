@@ -1,0 +1,304 @@
+"""SAC — soft actor-critic for continuous control.
+
+Reference analogue: `rllib/algorithms/sac/sac.py` (twin Q, tanh-squashed
+Gaussian policy, automatic entropy temperature).  TPU-first: the whole
+update (twin-critic TD, reparameterized actor, alpha, polyak) jits to one
+XLA program; rollouts stay on CPU EnvRunner actors via the same
+``action_fn`` seam DQN uses (the continuous action array rides the
+generic SampleBatch columns).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS,
+)
+
+__all__ = ["SACConfig", "SAC", "sac_action_fn"]
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(rng, sizes, out_dim, out_scale=0.01):
+    import jax
+    import jax.numpy as jnp
+
+    params = {}
+    keys = jax.random.split(rng, len(sizes))
+    dims = list(sizes)
+    for i in range(len(dims) - 1):
+        scale = jnp.sqrt(2.0 / dims[i])
+        params[f"fc_{i}"] = {
+            "w": jax.random.normal(keys[i], (dims[i], dims[i + 1]),
+                                   jnp.float32) * scale,
+            "b": jnp.zeros((dims[i + 1],)),
+        }
+    params["out"] = {
+        "w": jax.random.normal(keys[-1], (dims[-1], out_dim),
+                               jnp.float32) * out_scale,
+        "b": jnp.zeros((out_dim,)),
+    }
+    return params
+
+
+def _mlp_apply(params, x):
+    import jax.numpy as jnp
+
+    i = 0
+    while f"fc_{i}" in params:
+        p = params[f"fc_{i}"]
+        x = jnp.tanh(x @ p["w"] + p["b"])
+        i += 1
+    return x @ params["out"]["w"] + params["out"]["b"]
+
+
+def init_sac_nets(rng, obs_dim: int, act_dim: int, hidden=(256, 256)):
+    import jax
+
+    ka, k1, k2 = jax.random.split(rng, 3)
+    sizes = [obs_dim, *hidden]
+    qsizes = [obs_dim + act_dim, *hidden]
+    return {
+        "actor": _mlp_init(ka, sizes, 2 * act_dim),
+        "q1": _mlp_init(k1, qsizes, 1, out_scale=1.0),
+        "q2": _mlp_init(k2, qsizes, 1, out_scale=1.0),
+    }
+
+
+def actor_dist(actor_params, obs):
+    """-> (mean, log_std) of the pre-squash Gaussian."""
+    import jax.numpy as jnp
+
+    out = _mlp_apply(actor_params, obs.reshape(obs.shape[0], -1))
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+
+
+def sample_squashed(actor_params, obs, key):
+    """Reparameterized tanh-Gaussian sample -> (action in [-1,1], logp)."""
+    import jax
+    import jax.numpy as jnp
+
+    mean, log_std = actor_dist(actor_params, obs)
+    std = jnp.exp(log_std)
+    z = mean + std * jax.random.normal(key, mean.shape)
+    a = jnp.tanh(z)
+    # logp with tanh change-of-variables (numerically stable form)
+    logp_z = -0.5 * (((z - mean) / std) ** 2 + 2 * log_std
+                     + jnp.log(2 * jnp.pi))
+    correction = 2.0 * (jnp.log(2.0) - z - jax.nn.softplus(-2.0 * z))
+    logp = jnp.sum(logp_z - correction, axis=-1)
+    return a, logp
+
+
+def sac_action_fn(weights, obs, key):
+    """EnvRunner action seam: tanh-Gaussian sample scaled to the env's
+    action range (low/high ride the weights payload)."""
+    import jax.numpy as jnp
+
+    a, logp = sample_squashed(weights["params"]["actor"],
+                              obs.astype(jnp.float32), key)
+    low, high = weights["act_low"], weights["act_high"]
+    action = low + (a + 1.0) * 0.5 * (high - low)
+    zeros = jnp.zeros(a.shape[0], jnp.float32)
+    return action, logp, zeros
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.buffer_size = 100_000
+        self.train_batch_size = 256
+        self.learning_starts = 512
+        self.num_updates_per_iter = 64
+        self.tau = 0.005                 # polyak target coefficient
+        self.target_entropy = None       # default: -act_dim
+        self.hidden = (256, 256)
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    _config_cls = SACConfig
+
+    def runner_kwargs(self) -> Dict[str, Any]:
+        return {"action_fn": sac_action_fn, "store_next_obs": True}
+
+    def build_learner(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.replay_buffers import ReplayBuffer
+
+        cfg = self.algo_config
+        env = cfg.env_creator()
+        obs_dim = int(np.prod(env.observation_space.shape))
+        space = env.action_space
+        act_dim = int(np.prod(space.shape))
+        self._act_low = np.asarray(space.low, np.float32).reshape(act_dim)
+        self._act_high = np.asarray(space.high, np.float32).reshape(act_dim)
+        env.close()
+
+        self.params = init_sac_nets(
+            jax.random.PRNGKey(cfg.seed), obs_dim, act_dim, cfg.hidden)
+        self.target_params = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.log_alpha = jnp.zeros(())
+        self._opt = optax.adam(cfg.lr)
+        self._alpha_opt = optax.adam(cfg.alpha_lr)
+        self.opt_state = self._opt.init(self.params)
+        self.alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+
+        gamma, tau = cfg.gamma, cfg.tau
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None else -act_dim)
+        low = jnp.asarray(self._act_low)
+        high = jnp.asarray(self._act_high)
+
+        def q_apply(qp, obs, act):
+            x = jnp.concatenate([obs.reshape(obs.shape[0], -1), act], -1)
+            return _mlp_apply(qp, x)[..., 0]
+
+        def update(params, target_params, log_alpha, opt_state,
+                   alpha_opt_state, batch, key):
+            obs = batch[OBS].astype(jnp.float32)
+            nobs = batch[NEXT_OBS].astype(jnp.float32)
+            # env-scale actions -> [-1, 1] (the squashed policy's range)
+            act = (batch[ACTIONS] - low) / (high - low) * 2.0 - 1.0
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # ---- critic target
+            na, nlogp = sample_squashed(params["actor"], nobs, k1)
+            qt = jnp.minimum(
+                q_apply(target_params["q1"], nobs, na),
+                q_apply(target_params["q2"], nobs, na))
+            target = batch[REWARDS] + gamma * (1.0 - batch[DONES]) * (
+                qt - alpha * nlogp)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1 = q_apply(p["q1"], obs, act)
+                q2 = q_apply(p["q2"], obs, act)
+                return (jnp.mean((q1 - target) ** 2)
+                        + jnp.mean((q2 - target) ** 2))
+
+            def actor_loss(p):
+                a, logp = sample_squashed(p["actor"], obs, k2)
+                q = jnp.minimum(q_apply(p["q1"], obs, a),
+                                q_apply(p["q2"], obs, a))
+                return jnp.mean(alpha * logp - q), logp
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(params)
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params)
+            # critic grads update q nets; actor grads update the actor only
+            grads = {
+                "actor": a_grads["actor"],
+                "q1": c_grads["q1"],
+                "q2": c_grads["q2"],
+            }
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # ---- temperature
+            def alpha_loss_fn(la):
+                return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy))
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+            al_updates, alpha_opt_state = self._alpha_opt.update(
+                al_grad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, al_updates)
+
+            # ---- polyak targets
+            target_params = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o,
+                target_params, {"q1": params["q1"], "q2": params["q2"]})
+            return (params, target_params, log_alpha, opt_state,
+                    alpha_opt_state,
+                    {"critic_loss": c_loss, "actor_loss": a_loss,
+                     "alpha": alpha})
+
+        self._update = jax.jit(update, donate_argnums=(0, 1, 3, 4))
+
+    def get_weights(self):
+        import jax
+
+        return {"params": {"actor": jax.tree.map(np.asarray,
+                                                 self.params["actor"])},
+                "act_low": self._act_low, "act_high": self._act_high}
+
+    def set_weights(self, weights):
+        self.params["actor"] = weights["params"]["actor"]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.algo_config
+        rollouts = self.synchronous_parallel_sample()
+        steps_this_iter = 0
+        for ro in rollouts:
+            b = ro["batch"]
+            steps_this_iter += len(b[REWARDS])
+            self.buffer.add({
+                OBS: b[OBS], ACTIONS: b[ACTIONS], REWARDS: b[REWARDS],
+                NEXT_OBS: b[NEXT_OBS], DONES: b[DONES],
+            })
+
+        metrics = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                sample = self.buffer.sample(cfg.train_batch_size)
+                sample.pop("batch_indexes", None)
+                self._rng, sub = jax.random.split(self._rng)
+                (self.params, self.target_params, self.log_alpha,
+                 self.opt_state, self.alpha_opt_state, metrics) = \
+                    self._update(self.params, self.target_params,
+                                 self.log_alpha, self.opt_state,
+                                 self.alpha_opt_state, sample, sub)
+        self.sync_weights()
+        out = {k: float(v) for k, v in metrics.items()}
+        out.update({"buffer_size": len(self.buffer),
+                    "_steps_this_iter": steps_this_iter})
+        return out
+
+    def save_checkpoint(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray,
+                                              self.target_params),
+                "log_alpha": np.asarray(self.log_alpha),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "alpha_opt_state": jax.tree.map(np.asarray,
+                                                self.alpha_opt_state),
+                "total_env_steps": self._total_env_steps}
+
+    def load_checkpoint(self, state):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.target_params = jax.tree.map(jnp.asarray,
+                                          state["target_params"])
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+        if "opt_state" in state:
+            self.opt_state = jax.tree.map(jnp.asarray, state["opt_state"])
+            self.alpha_opt_state = jax.tree.map(
+                jnp.asarray, state["alpha_opt_state"])
+        self._total_env_steps = state.get("total_env_steps", 0)
+        # the runners must roll out with the RESTORED actor, not whatever
+        # they had before (base-class contract)
+        self.sync_weights()
