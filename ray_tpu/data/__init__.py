@@ -25,6 +25,8 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,
     read_binary_files,
+    read_images,
+    read_tfrecords,
     read_csv,
     read_json,
     read_parquet,
@@ -39,4 +41,6 @@ __all__ = [
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files",
+    "read_images",
+    "read_tfrecords",
 ]

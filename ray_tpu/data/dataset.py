@@ -285,6 +285,22 @@ def _remote(fn, **opts):
 DEFAULT_WINDOW = 16
 
 
+class _SplitCoordinator:
+    """Streaming-split claim server (runs as a zero-CPU actor): each
+    epoch's block indices are claimed exactly once across all shards."""
+
+    def __init__(self, n_blocks: int):
+        self._n = n_blocks
+        self._next: Dict[int, int] = {}  # epoch -> next unclaimed index
+
+    def claim(self, epoch: int) -> Optional[int]:
+        nxt = self._next.get(epoch, 0)
+        if nxt >= self._n:
+            return None
+        self._next[epoch] = nxt + 1
+        return nxt
+
+
 class _Source:
     """A pending block: either an existing ref or an unread read task."""
 
@@ -337,6 +353,78 @@ def _actor_stage(block_iter, actor_ops: List[_OpSpec],
                 ray_tpu.kill(a)
             except Exception:  # noqa: BLE001
                 pass
+
+
+def _batches_from_block_iter(block_iter, *, batch_size: int,
+                             batch_format: str, drop_last: bool,
+                             local_shuffle_buffer_size=None,
+                             local_shuffle_seed=None):
+    """Assemble fixed-size batches from a stream of LOCAL blocks — shared
+    by Dataset.iter_batches and the streaming-split shard iterators."""
+    rng = (np.random.default_rng(local_shuffle_seed)
+           if local_shuffle_buffer_size else None)
+    # carry: deque of (block, offset) — rows [offset:] are unconsumed.
+    # Slicing from the front instead of re-concatenating the remainder
+    # keeps iteration linear (each row is copied at most once).
+    carry: deque = deque()
+    carry_rows = 0
+    shuffle_buf: List[Block] = []
+    shuffle_rows = 0
+
+    def emit(block: Block) -> Iterator[Any]:
+        nonlocal carry_rows
+        n = BlockAccessor.for_block(block).num_rows()
+        if n:
+            carry.append((block, 0))
+            carry_rows += n
+        while carry_rows >= batch_size:
+            need = batch_size
+            parts: List[Block] = []
+            while need > 0:
+                blk, off = carry[0]
+                acc = BlockAccessor.for_block(blk)
+                avail = acc.num_rows() - off
+                take = min(avail, need)
+                parts.append(acc.slice(off, off + take))
+                need -= take
+                if take == avail:
+                    carry.popleft()
+                else:
+                    carry[0] = (blk, off + take)
+            carry_rows -= batch_size
+            batch = (parts[0] if len(parts) == 1
+                     else BlockAccessor.concat(parts))
+            yield BlockAccessor.for_block(batch).to_batch(batch_format)
+
+    def through_shuffle(block: Block) -> Iterator[Block]:
+        nonlocal shuffle_buf, shuffle_rows
+        if rng is None:
+            yield block
+            return
+        shuffle_buf.append(block)
+        shuffle_rows += BlockAccessor.for_block(block).num_rows()
+        if shuffle_rows >= local_shuffle_buffer_size:
+            merged = BlockAccessor.concat(shuffle_buf)
+            acc = BlockAccessor.for_block(merged)
+            perm = rng.permutation(acc.num_rows())
+            shuffle_buf, shuffle_rows = [], 0
+            yield acc.take_rows(perm)
+
+    for block in block_iter:
+        for shuffled in through_shuffle(block):
+            yield from emit(shuffled)
+    if shuffle_buf:
+        merged = BlockAccessor.concat(shuffle_buf)
+        acc = BlockAccessor.for_block(merged)
+        perm = rng.permutation(acc.num_rows())
+        yield from emit(acc.take_rows(perm))
+    if carry_rows and not drop_last:
+        merged = BlockAccessor.concat(
+            [BlockAccessor.for_block(b).slice(
+                off, BlockAccessor.for_block(b).num_rows())
+             for b, off in carry])
+        if BlockAccessor.for_block(merged).num_rows():
+            yield BlockAccessor.for_block(merged).to_batch(batch_format)
 
 
 def _stream_blocks(sources: List[_Source], ops: List[_OpSpec],
@@ -508,71 +596,12 @@ class Dataset:
                      prefetch_blocks: int = DEFAULT_WINDOW
                      ) -> Iterator[Any]:
         """Stream batches; at most ``prefetch_blocks`` map tasks in flight."""
-        rng = (np.random.default_rng(local_shuffle_seed)
-               if local_shuffle_buffer_size else None)
-        # carry: deque of (block, offset) — rows [offset:] are unconsumed.
-        # Slicing from the front instead of re-concatenating the remainder
-        # keeps iteration linear (each row is copied at most once).
-        carry: deque = deque()
-        carry_rows = 0
-        shuffle_buf: List[Block] = []
-        shuffle_rows = 0
-
-        def emit(block: Block) -> Iterator[Any]:
-            nonlocal carry_rows
-            n = BlockAccessor.for_block(block).num_rows()
-            if n:
-                carry.append((block, 0))
-                carry_rows += n
-            while carry_rows >= batch_size:
-                need = batch_size
-                parts: List[Block] = []
-                while need > 0:
-                    blk, off = carry[0]
-                    acc = BlockAccessor.for_block(blk)
-                    avail = acc.num_rows() - off
-                    take = min(avail, need)
-                    parts.append(acc.slice(off, off + take))
-                    need -= take
-                    if take == avail:
-                        carry.popleft()
-                    else:
-                        carry[0] = (blk, off + take)
-                carry_rows -= batch_size
-                batch = (parts[0] if len(parts) == 1
-                         else BlockAccessor.concat(parts))
-                yield BlockAccessor.for_block(batch).to_batch(batch_format)
-
-        def through_shuffle(block: Block) -> Iterator[Block]:
-            nonlocal shuffle_buf, shuffle_rows
-            if rng is None:
-                yield block
-                return
-            shuffle_buf.append(block)
-            shuffle_rows += BlockAccessor.for_block(block).num_rows()
-            if shuffle_rows >= local_shuffle_buffer_size:
-                merged = BlockAccessor.concat(shuffle_buf)
-                acc = BlockAccessor.for_block(merged)
-                perm = rng.permutation(acc.num_rows())
-                shuffle_buf, shuffle_rows = [], 0
-                yield acc.take_rows(perm)
-
-        for eb in self._stream(prefetch_blocks):
-            block = ray_tpu.get(eb.ref)
-            for shuffled in through_shuffle(block):
-                yield from emit(shuffled)
-        if shuffle_buf:
-            merged = BlockAccessor.concat(shuffle_buf)
-            acc = BlockAccessor.for_block(merged)
-            perm = rng.permutation(acc.num_rows())
-            yield from emit(acc.take_rows(perm))
-        if carry_rows and not drop_last:
-            merged = BlockAccessor.concat(
-                [BlockAccessor.for_block(b).slice(
-                    off, BlockAccessor.for_block(b).num_rows())
-                 for b, off in carry])
-            if BlockAccessor.for_block(merged).num_rows():
-                yield BlockAccessor.for_block(merged).to_batch(batch_format)
+        return _batches_from_block_iter(
+            (ray_tpu.get(eb.ref) for eb in self._stream(prefetch_blocks)),
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
 
     def write_parquet(self, path: str,
                       timeout_s: float = 600.0) -> List[str]:
@@ -580,6 +609,13 @@ class Dataset:
         ``Dataset.write_parquet`` / `data/datasource/parquet_datasink`);
         runs as distributed tasks, returns the written file paths."""
         return self._write_files(path, "parquet", timeout_s)
+
+    def write_tfrecords(self, path: str,
+                        timeout_s: float = 600.0) -> List[str]:
+        """One TFRecord file per block (reference:
+        ``Dataset.write_tfrecords``); `tf.train.Example` framing with real
+        CRC32C checksums via the built-in codec — no tensorflow."""
+        return self._write_files(path, "tfrecords", timeout_s)
 
     def write_csv(self, path: str, timeout_s: float = 600.0) -> List[str]:
         """One CSV file per block (reference: ``Dataset.write_csv``)."""
@@ -607,6 +643,21 @@ class Dataset:
                 pq.write_table(acc.to_batch("pyarrow"), out_path)
             elif fmt == "csv":
                 acc.to_batch("pandas").to_csv(out_path, index=False)
+            elif fmt == "tfrecords":
+                import struct as _struct
+
+                from ray_tpu.data.read_api import (
+                    _encode_example, _masked_crc,
+                )
+
+                with open(out_path, "wb") as f:
+                    for row in acc.iter_rows():
+                        payload = _encode_example(row)
+                        hdr = _struct.pack("<Q", len(payload))
+                        f.write(hdr)
+                        f.write(_struct.pack("<I", _masked_crc(hdr)))
+                        f.write(payload)
+                        f.write(_struct.pack("<I", _masked_crc(payload)))
             else:  # json lines
                 acc.to_batch("pandas").to_json(out_path, orient="records",
                                                lines=True)
@@ -764,6 +815,45 @@ class Dataset:
         return [Dataset([ds._sources[i] for i in idxs],
                         metas=[ds._metas[i] for i in idxs])
                 for idxs in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[Any]:
+        """N iterators consuming DISJOINT streamed shards of this dataset
+        without up-front materialization (reference:
+        `python/ray/data/_internal/iterator/stream_split_iterator.py:1`).
+
+        A coordinator actor hands out block indices on demand, so fast
+        consumers take more blocks (pull-based balancing) and each block
+        executes through the lazy op chain only when claimed.  The shards
+        jointly cover every block exactly once per epoch; iterating a
+        shard again starts a new epoch over a fresh claim sequence.
+        ``equal`` is accepted for API parity (block-granular splits are
+        balanced by the pull loop, not by row counts)."""
+        import ray_tpu
+        from ray_tpu.data.iterator import StreamSplitDataIterator
+
+        if any(op.compute is not None for op in self._ops):
+            raise ValueError(
+                "streaming_split does not support actor-compute op chains; "
+                "materialize() the actor stage first")
+        coord = ray_tpu.remote(num_cpus=0)(_SplitCoordinator).remote(
+            len(self._sources))
+        return [StreamSplitDataIterator(self, coord, i, n)
+                for i in range(n)]
+
+    def _execute_block(self, i: int):
+        """Submit source ``i`` through the (task-only) op chain; returns a
+        block ref — the streaming-split shard prefetch path."""
+        src = self._sources[i]
+        if src.read_fn is not None:
+            ref, _ = _remote(_read_task, num_returns=2).remote(
+                src.read_fn, self._ops)
+        elif self._ops:
+            ref, _ = _remote(_map_block_task, num_returns=2).remote(
+                self._ops, src.ref)
+        else:
+            ref = src.ref
+        return ref
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         """Distributed 2-stage shuffle (reference:

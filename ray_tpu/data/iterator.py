@@ -58,3 +58,72 @@ class DataIterator:
 
     def __repr__(self):
         return f"DataIterator({self._dataset!r})"
+
+
+class StreamSplitDataIterator(DataIterator):
+    """One shard of ``Dataset.streaming_split(n)`` (reference:
+    `_internal/iterator/stream_split_iterator.py`).
+
+    Blocks are claimed from the split coordinator on demand and executed
+    through the dataset's lazy op chain with a small prefetch pipeline —
+    nothing materializes up front, and whatever this consumer doesn't
+    claim goes to its siblings."""
+
+    def __init__(self, dataset, coordinator, index: int, world: int):
+        super().__init__(dataset)
+        self._coord = coordinator
+        self.index = index
+        self.world = world
+        self._epoch = 0
+
+    def _claimed_blocks(self):
+        """Generator of local blocks for this epoch (prefetch depth 2)."""
+        import ray_tpu
+
+        epoch = self._epoch
+        self._epoch += 1
+
+        def claim():
+            return ray_tpu.get(self._coord.claim.remote(epoch), timeout=120)
+
+        pending = []
+        for _ in range(2):
+            i = claim()
+            if i is None:
+                break
+            pending.append(self._dataset._execute_block(i))
+        while pending:
+            ref = pending.pop(0)
+            i = claim()
+            if i is not None:
+                pending.append(self._dataset._execute_block(i))
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        from ray_tpu.data.dataset import _batches_from_block_iter
+
+        return _batches_from_block_iter(
+            self._claimed_blocks(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Any]:
+        from ray_tpu.data.block import BlockAccessor
+
+        for block in self._claimed_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def count(self) -> int:
+        raise TypeError("a streaming-split shard has no static count — "
+                        "its share of blocks is decided by the pull loop")
+
+    def materialize(self):
+        raise TypeError("streaming-split shards are consume-once streams")
+
+    def __repr__(self):
+        return (f"StreamSplitDataIterator({self.index}/{self.world}, "
+                f"{self._dataset!r})")

@@ -202,3 +202,280 @@ def read_binary_files(paths: Union[str, List[str]], *,
         return read
 
     return Dataset.from_read_fns([make(f) for f in files])
+
+
+_IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(paths: Union[str, List[str]], *,
+                size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False,
+                parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """Image-folder reader (reference: `python/ray/data/read_api.py:679`
+    ``read_images``): one read task per file batch decodes via PIL into an
+    ``image`` column of (H, W, C) uint8 arrays.
+
+    ``size=(h, w)`` resizes at decode (so a folder of mixed sizes yields a
+    stackable column); ``mode`` forces a PIL mode ("RGB", "L", ...)."""
+    files = [f for f in _expand_paths(paths, None)
+             if f.lower().endswith(_IMAGE_SUFFIXES)]
+    if not files:
+        raise FileNotFoundError(f"no image files under {paths}")
+    parallelism = max(1, min(parallelism, len(files)))
+    chunks = np.array_split(np.asarray(files, dtype=object), parallelism)
+
+    def make(chunk):
+        def read():
+            from PIL import Image
+
+            imgs, names = [], []
+            for fname in chunk:
+                with Image.open(fname) as im:
+                    if mode is not None:
+                        im = im.convert(mode)
+                    elif im.mode not in ("RGB", "L"):
+                        im = im.convert("RGB")
+                    if size is not None:
+                        im = im.resize((size[1], size[0]))
+                    imgs.append(np.asarray(im))
+                names.append(fname)
+            same_shape = len({a.shape for a in imgs}) == 1
+            if same_shape:
+                col = np.stack(imgs)
+            else:
+                # np.asarray(.., object) broadcasts partially-matching
+                # shapes (8x8x3 vs 8x9x3) into a ValueError — fill an
+                # object array explicitly
+                col = np.empty(len(imgs), dtype=object)
+                col[:] = imgs
+            block = {"image": col}
+            if include_paths:
+                block["path"] = np.asarray(names, dtype=object)
+            return block
+        return read
+
+    return Dataset.from_read_fns([make(c) for c in chunks if len(c)])
+
+
+# --------------------------------------------------------------------------
+# TFRecords — dependency-free wire codec (framing + tf.train.Example proto)
+
+_CRC_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC32-Castagnoli (the TFRecord checksum; zlib.crc32 uses the wrong
+    polynomial).  Accelerated library when present; pure-python table
+    loop as the dependency-free fallback (fine for test-scale files,
+    ~10 MB/s for big ones)."""
+    try:
+        import crc32c as _c
+
+        return _c.crc32c(data)
+    except ImportError:
+        pass
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in builtins_range(256):
+            c = i
+            for _ in builtins_range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, off: int):
+    n = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _encode_feature(values) -> bytes:
+    """tf.train.Feature: bytes_list=1 | float_list=2 | int64_list=3."""
+    import struct as _struct
+
+    if len(values) == 0:
+        return _ld(1, b"")  # empty BytesList (decoder yields [])
+    v0 = values[0]
+    if isinstance(v0, (bytes, str)):
+        payload = b"".join(
+            _ld(1, v if isinstance(v, bytes) else v.encode()) for v in values)
+        return _ld(1, payload)
+    if isinstance(v0, (float, np.floating)):
+        packed = _struct.pack(f"<{len(values)}f", *values)
+        return _ld(2, _ld(1, packed))
+    payload = b"".join(_varint(int(v) & (2 ** 64 - 1)) for v in values)
+    return _ld(3, _ld(1, payload))
+
+
+def _encode_example(row: dict) -> bytes:
+    entries = []
+    for k, v in row.items():
+        if isinstance(v, np.ndarray):
+            v = v.tolist()
+        if not isinstance(v, (list, tuple)):
+            v = [v]
+        feature = _encode_feature(v)
+        entries.append(_ld(1, _ld(1, k.encode()) + _ld(2, feature)))
+    return _ld(1, b"".join(entries))  # Example.features
+
+
+def _decode_fields(buf: bytes):
+    """Yield (field_no, wire_type, value) over one message's bytes."""
+    off = 0
+    while off < len(buf):
+        tag, off = _read_varint(buf, off)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, off = _read_varint(buf, off)
+        elif wire == 2:
+            ln, off = _read_varint(buf, off)
+            val = buf[off:off + ln]
+            off += ln
+        elif wire == 5:
+            val = buf[off:off + 4]
+            off += 4
+        elif wire == 1:
+            val = buf[off:off + 8]
+            off += 8
+        else:
+            raise ValueError(f"unsupported proto wire type {wire}")
+        yield field, wire, val
+
+
+def _decode_feature(buf: bytes):
+    import struct as _struct
+
+    for field, wire, val in _decode_fields(buf):
+        if field == 1:  # BytesList
+            return [v for f, _, v in _decode_fields(val) if f == 1]
+        if field == 2:  # FloatList (packed or repeated)
+            out = []
+            for f, w, v in _decode_fields(val):
+                if f != 1:
+                    continue
+                if w == 2:
+                    out.extend(_struct.unpack(f"<{len(v) // 4}f", v))
+                else:
+                    out.append(_struct.unpack("<f", v)[0])
+            return out
+        if field == 3:  # Int64List (packed varints or repeated)
+            out = []
+            for f, w, v in _decode_fields(val):
+                if f != 1:
+                    continue
+                if w == 2:
+                    off = 0
+                    while off < len(v):
+                        n, off = _read_varint(v, off)
+                        out.append(n - 2 ** 64 if n >= 2 ** 63 else n)
+                else:
+                    out.append(v - 2 ** 64 if v >= 2 ** 63 else v)
+            return out
+    return []
+
+
+def _decode_example(buf: bytes) -> dict:
+    row = {}
+    for field, _, features in _decode_fields(buf):
+        if field != 1:
+            continue
+        for f2, _, entry in _decode_fields(features):
+            if f2 != 1:
+                continue
+            name, feat = None, None
+            for f3, _, v in _decode_fields(entry):
+                if f3 == 1:
+                    name = v.decode()
+                elif f3 == 2:
+                    feat = v
+            if name is not None and feat is not None:
+                row[name] = _decode_feature(feat)
+    return row
+
+
+def _iter_tfrecord_frames(data: bytes):
+    import struct as _struct
+
+    off = 0
+    while off < len(data):
+        (length,) = _struct.unpack_from("<Q", data, off)
+        off += 12  # u64 length + u32 length-crc
+        yield data[off:off + length]
+        off += length + 4  # payload + u32 data-crc
+
+
+def read_tfrecords(paths: Union[str, List[str]], *,
+                   parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """TFRecord reader (reference: `python/ray/data/read_api.py`
+    ``read_tfrecords``): parses the record framing and `tf.train.Example`
+    protos with a built-in codec — no tensorflow dependency.  Scalar
+    features become scalar columns; multi-value features become object
+    columns of lists.  Directories are filtered to *.tfrecords? / *.tfrecord
+    files so stray markers (_SUCCESS, READMEs) don't parse as framing."""
+    if isinstance(paths, str) and os.path.isdir(paths):
+        files = [f for f in _expand_paths(paths, None)
+                 if f.endswith((".tfrecords", ".tfrecord"))]
+        if not files:
+            raise FileNotFoundError(f"no .tfrecord(s) files under {paths}")
+    else:
+        files = _expand_paths(paths, None)
+
+    def make(fname):
+        def read():
+            with open(fname, "rb") as f:
+                data = f.read()
+            rows = [_decode_example(frame)
+                    for frame in _iter_tfrecord_frames(data)]
+            if not rows:
+                return {}
+            cols: dict = {}
+            for key in rows[0]:
+                vals = [r.get(key, []) for r in rows]
+                if all(len(v) == 1 for v in vals):
+                    flat = [v[0] for v in vals]
+                    if isinstance(flat[0], bytes):
+                        cols[key] = np.asarray(flat, dtype=object)
+                    else:
+                        cols[key] = np.asarray(flat)
+                else:
+                    cols[key] = np.asarray(vals, dtype=object)
+            return cols
+        return read
+
+    return Dataset.from_read_fns([make(f) for f in files])

@@ -79,7 +79,20 @@ class DataParallelTrainer:
 
             shards_per_rank = [dict() for _ in range(world_size)]
             for name, ds in datasets.items():
-                if hasattr(ds, "split"):
+                if hasattr(ds, "streaming_split"):
+                    # disjoint STREAMED shards — blocks are claimed from a
+                    # coordinator as each worker consumes, never sliced up
+                    # front (reference: stream_split_iterator.py, the
+                    # reference's default Train ingest).  NOTE the shard is
+                    # a consume-style iterator: count()/materialize() are
+                    # unavailable on it (its share is decided by the pull
+                    # loop) — loops needing a static count should count the
+                    # dataset before passing it in.
+                    try:
+                        parts = ds.streaming_split(world_size)
+                    except ValueError:  # actor-compute chain: static split
+                        parts = ds.split(world_size)
+                elif hasattr(ds, "split"):
                     parts = ds.split(world_size)
                 else:  # plain list/iterable: round-robin
                     parts = [ds] * world_size

@@ -278,3 +278,27 @@ class TestNodeFailure:
         finally:
             c.shutdown()
 
+
+def test_push_shuffle_bigger_than_store():
+    """Distributed scatter/merge shuffle of a dataset LARGER than the
+    object store: blocks spill to disk and the shuffle still completes
+    with every row intact (reference: `_internal/push_based_shuffle.py`
+    under memory pressure) — run on a fake 2-node cluster."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+
+    c = Cluster(initialize_head=True,
+                head_resources={"num_cpus": 2, "object_store_mb": 32})
+    try:
+        c.add_node(num_cpus=2, object_store_mb=32)
+        c.wait_for_nodes(2)
+        c.connect()
+        n = 4 << 20  # 8 blocks x (2 cols x 8B x 512Ki rows) = 64MB >> 32MB
+        ds = rd.range(n, parallelism=8).map_batches(
+            lambda b: {"id": b["id"], "pad": b["id"].astype(np.int64)})
+        out = ds.random_shuffle(seed=3)
+        assert out.count() == n
+        assert out.sum("id") == n * (n - 1) // 2
+    finally:
+        c.shutdown()
