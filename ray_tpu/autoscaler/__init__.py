@@ -212,11 +212,20 @@ class StandardAutoscaler:
                 self.num_launches += n
 
         # 3. idle scale-down (never below min_workers, never the head).
+        # Provider keys are runtime node ids for the local provider but
+        # INSTANCE NAMES for cloud providers; join those through the
+        # registered hostname (a GCE VM's hostname leads with its
+        # instance name: "<instance>.c.<project>.internal").
         if not demands:
             for nid, m in alive.items():
-                if nid == self.head_node_id or nid not in provider_nodes:
+                if nid == self.head_node_id:
                     continue
-                t = provider_nodes[nid]
+                key = nid
+                if key not in provider_nodes:
+                    key = m.get("hostname", "").split(".", 1)[0]
+                if key not in provider_nodes:
+                    continue
+                t = provider_nodes[key]
                 floor = self.node_types.get(t, {}).get("min_workers", 0)
                 if counts.get(t, 0) <= floor:
                     continue
@@ -228,7 +237,7 @@ class StandardAutoscaler:
                         self._gcs.drain_node(nid)
                     except Exception:  # noqa: BLE001
                         pass
-                    self.provider.terminate_node(nid)
+                    self.provider.terminate_node(key)
                     try:
                         self._gcs.unregister_node(nid)
                     except Exception:  # noqa: BLE001

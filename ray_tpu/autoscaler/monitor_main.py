@@ -28,11 +28,15 @@ def load_config(path: str) -> dict:
     cfg.setdefault("head_node", {"resources": {"CPU": 1}})
     cfg.setdefault("worker_node_types", {})
     provider = cfg.setdefault("provider", {"type": "local"})
-    if provider.get("type", "local") != "local":
+    if provider.get("type", "local") not in ("local", "gce"):
         raise ValueError(
-            f"provider type {provider.get('type')!r} not available in this "
-            "build — 'local' is implemented; cloud providers plug in via "
+            f"provider type {provider.get('type')!r} not available — "
+            "'local' and 'gce' are implemented; others plug in via "
             "ray_tpu.autoscaler.NodeProvider")
+    if provider.get("type") == "gce":
+        for key in ("project", "zone"):
+            if not provider.get(key):
+                raise ValueError(f"gce provider config needs {key!r}")
     return cfg
 
 
@@ -57,7 +61,15 @@ def main():
                                                  {"CPU": 1}).items()}
     head = cluster_utils.spawn_raylet(
         address, head_res, cfg["head_node"].get("object_store_mb", 128), env)
-    provider = LocalNodeProvider(address, cfg["worker_node_types"])
+    if cfg["provider"].get("type") == "gce":
+        from ray_tpu.autoscaler.gce import GceNodeProvider, RestGceApi
+
+        provider = GceNodeProvider(
+            address, cfg["worker_node_types"],
+            RestGceApi(cfg["provider"]["project"], cfg["provider"]["zone"]),
+            cluster_name=cfg["cluster_name"])
+    else:
+        provider = LocalNodeProvider(address, cfg["worker_node_types"])
     autoscaler = StandardAutoscaler(
         address, provider, cfg["worker_node_types"],
         max_workers=cfg["max_workers"],

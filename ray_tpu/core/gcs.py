@@ -211,7 +211,14 @@ class GcsCore:
     def register_node(self, node_id: str, address: Optional[tuple],
                       resources: Dict[str, float],
                       store_path: Optional[str] = None,
-                      hostname: str = "") -> List[dict]:
+                      hostname: str = "",
+                      labels: Optional[Dict[str, str]] = None) -> List[dict]:
+        """``labels`` carry scheduler-visible topology metadata (SURVEY §7
+        items 3-4): ``accelerator_type`` (e.g. "v5e-8"), ``tpu_slice``
+        (the pod-slice id — nodes sharing it are ICI-adjacent),
+        ``tpu_topology`` ("2x4"), ``tpu_worker_id`` (coords within the
+        slice).  STRICT_PACK placement uses ``tpu_slice`` to pack bundles
+        across hosts of ONE slice when a single node can't hold them."""
         with self._lock:
             self._nodes[node_id] = {
                 "node_id": node_id,
@@ -220,6 +227,7 @@ class GcsCore:
                 "resources_available": dict(resources),
                 "store_path": store_path,
                 "hostname": hostname,
+                "labels": dict(labels or {}),
                 "alive": True,
                 "last_heartbeat": time.monotonic(),
             }
@@ -275,6 +283,7 @@ class GcsCore:
                 out.append({
                     "node_id": info["node_id"],
                     "alive": info["alive"],
+                    "hostname": info.get("hostname", ""),
                     "resources_total": dict(info["resources_total"]),
                     "resources_available": dict(
                         info.get("resources_available", {})),
@@ -516,6 +525,9 @@ class GcsCore:
             totals = {nid: dict(info["resources_total"])
                       for nid, info in self._nodes.items()
                       if info["alive"] and not info.get("draining")}
+            slices = {nid: info.get("labels", {}).get("tpu_slice")
+                      for nid, info in self._nodes.items()
+                      if info["alive"] and not info.get("draining")}
         if not nodes:
             return None
 
@@ -526,20 +538,40 @@ class GcsCore:
             for k, v in b.items():
                 avail[k] = avail.get(k, 0.0) - v
 
+        def pack_into(pool, nids):
+            """All bundles greedily into the given node set, or None."""
+            trial = {nid: dict(pool[nid]) for nid in nids if nid in pool}
+            out: Dict[int, str] = {}
+            for i, b in enumerate(bundles):
+                nid = next((n for n in trial if fits(trial[n], b)), None)
+                if nid is None:
+                    return None
+                take(trial[nid], b)
+                out[i] = nid
+            return out
+
         assignments: Dict[int, str] = {}
         if strategy in ("STRICT_PACK", "PACK"):
             # one node for everything when possible
             for pool in (nodes, totals):
                 for nid in pool:
-                    trial = dict(pool[nid])
-                    ok = True
-                    for b in bundles:
-                        if not fits(trial, b):
-                            ok = False
-                            break
-                        take(trial, b)
-                    if ok:
-                        return {i: nid for i in range(len(bundles))}
+                    got = pack_into(pool, [nid])
+                    if got is not None:
+                        return got
+            # TPU extension (SURVEY §7 items 3-4): a bundle set too big for
+            # one host still packs onto ONE ICI domain — all hosts sharing
+            # a tpu_slice label are directly connected, so same-slice
+            # multi-host placement preserves STRICT_PACK's locality intent
+            # where plain Ray would just fail.
+            slice_groups: Dict[str, List[str]] = {}
+            for nid, sl in slices.items():
+                if sl:
+                    slice_groups.setdefault(sl, []).append(nid)
+            for pool in (nodes, totals):
+                for sl, nids in sorted(slice_groups.items()):
+                    got = pack_into(pool, sorted(nids))
+                    if got is not None:
+                        return got
             if strategy == "STRICT_PACK":
                 return None
         if strategy == "STRICT_SPREAD":

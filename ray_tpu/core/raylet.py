@@ -119,6 +119,30 @@ class SimpleFuture:
         return self._value
 
 
+
+def _node_topology_labels() -> Dict[str, str]:
+    """Scheduler-visible TPU topology labels from the environment (SURVEY
+    §7 items 3-4): a TPU-VM pod-slice worker exports its slice identity
+    via the TPU runtime env (or the RAY_TPU_* overrides used in tests);
+    nodes sharing ``tpu_slice`` are ICI-adjacent and STRICT_PACK bundles
+    prefer staying inside one slice."""
+    labels: Dict[str, str] = {}
+    env = os.environ
+    for key, sources in (
+            ("accelerator_type", ("RAY_TPU_ACCELERATOR_TYPE",
+                                  "TPU_ACCELERATOR_TYPE")),
+            ("tpu_slice", ("RAY_TPU_SLICE_ID", "TPU_NAME")),
+            ("tpu_topology", ("RAY_TPU_TOPOLOGY", "TPU_TOPOLOGY")),
+            ("tpu_worker_id", ("RAY_TPU_WORKER_ID", "TPU_WORKER_ID")),
+    ):
+        for var in sources:
+            val = env.get(var)
+            if val:
+                labels[key] = val
+                break
+    return labels
+
+
 class _WorkerConn:
     def __init__(self, sock, profile):
         self.sock = sock
@@ -422,9 +446,11 @@ class Raylet:
         else:
             self.gcs.subscribe_remote(node_id=self.node_id)
         address = (node_ip, self.tcp_port) if self.cluster_mode else None
+        self.node_labels = _node_topology_labels()
         for info in self.gcs.register_node(
                 self.node_id, address, self.resources_total,
-                store_path=store_path, hostname=socket.gethostname()):
+                store_path=store_path, hostname=socket.gethostname(),
+                labels=self.node_labels):
             if info["node_id"] != self.node_id and info["alive"]:
                 self._cluster_nodes[info["node_id"]] = info
 
@@ -1051,7 +1077,8 @@ class Raylet:
                 self.gcs.register_node(
                     self.node_id, (self.node_ip, self.tcp_port),
                     self.resources_total, store_path=self.store_path,
-                    hostname=socket.gethostname())
+                    hostname=socket.gethostname(),
+                    labels=self.node_labels)
         except (ConnectionError, TimeoutError, OSError):
             pass
         if not self._shutdown:
@@ -1124,7 +1151,8 @@ class Raylet:
         self._gcs_safe(self.gcs.register_node,
                        self.node_id, (self.node_ip, self.tcp_port),
                        self.resources_total, store_path=self.store_path,
-                       hostname=socket.gethostname())
+                       hostname=socket.gethostname(),
+                       labels=self.node_labels)
         for oid, st in self._objects.items():
             if st.status == "store":
                 self._gcs_safe(self.gcs.add_object_location,
