@@ -72,7 +72,23 @@ class RunConfig:
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:
-        base = self.storage_path or os.path.join(
-            os.path.expanduser("~"), "ray_tpu_results"
-        )
+        """The LOCAL working directory.  A URI storage_path (file://,
+        gs://, ... — reference: `tune/syncer.py`) stages locally and the
+        controller mirrors it to the URI via the registered Syncer."""
+        sp = self.storage_path or ""
+        if "://" in sp:
+            base = os.path.join(os.path.expanduser("~"),
+                                "ray_tpu_results", "_synced")
+            # never stage at the SHARED _synced root: an unnamed run would
+            # sync every other staged experiment into its own URI
+            return os.path.join(base, self.name or "default")
+        base = sp or os.path.join(os.path.expanduser("~"),
+                                  "ray_tpu_results")
         return os.path.join(base, self.name) if self.name else base
+
+    def storage_uri(self) -> Optional[str]:
+        """The remote mirror target (None for plain local paths)."""
+        sp = self.storage_path or ""
+        if "://" not in sp:
+            return None
+        return sp.rstrip("/") + "/" + (self.name or "default")

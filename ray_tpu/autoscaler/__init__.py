@@ -16,6 +16,7 @@ STRICT_PACK analogue) by materializing one node per slice.
 
 from __future__ import annotations
 
+import re as _re
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -222,7 +223,13 @@ class StandardAutoscaler:
                     continue
                 key = nid
                 if key not in provider_nodes:
-                    key = m.get("hostname", "").split(".", 1)[0]
+                    host = m.get("hostname", "").split(".", 1)[0]
+                    # TPU-VM workers append "-w-<i>" to the instance name;
+                    # strip it so any host of the slice joins to the one
+                    # cloud resource.  NOTE terminating that resource
+                    # removes the WHOLE slice — correct for idle slices
+                    # (all hosts idle together under gang-scheduled work).
+                    key = _re.sub(r"-w-\d+$", "", host)
                 if key not in provider_nodes:
                     continue
                 t = provider_nodes[key]

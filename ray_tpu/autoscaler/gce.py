@@ -25,6 +25,7 @@ that joins the cluster by GCS address.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import uuid
@@ -230,7 +231,10 @@ class GceNodeProvider(NodeProvider):
         spec = self._node_types[node_type]
         kind = spec.get("kind", "compute")
         for _ in range(count):
-            name = f"ray-tpu-{self._cluster}-{node_type}-" \
+            # GCE/TPU resource names must match [a-z]([-a-z0-9]*[a-z0-9])?
+            safe_type = re.sub(r"[^a-z0-9-]", "-", node_type.lower())
+            safe_cluster = re.sub(r"[^a-z0-9-]", "-", self._cluster.lower())
+            name = f"ray-tpu-{safe_cluster}-{safe_type}-" \
                    f"{uuid.uuid4().hex[:8]}"
             self._api.create_instance(
                 name, kind, spec,

@@ -27,6 +27,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    AskTellSearcher,
     BasicVariantGenerator,
     Searcher,
     TPESearcher,
@@ -47,7 +48,7 @@ __all__ = [
     "grid_search", "uniform", "loguniform", "randint", "choice",
     "sample_from", "generate_variants", "TrialScheduler", "FIFOScheduler",
     "ASHAScheduler", "MedianStoppingRule", "PopulationBasedTraining",
-    "Searcher", "BasicVariantGenerator", "TPESearcher",
+    "AskTellSearcher", "Searcher", "BasicVariantGenerator", "TPESearcher",
 ]
 
 
@@ -163,6 +164,23 @@ class Tuner:
     @classmethod
     def restore(cls, path: str, trainable, *,
                 tune_config: Optional[TuneConfig] = None) -> "RestoredTuner":
+        """``path`` may be a local experiment dir or a storage URI
+        (file://... — reference `tune/syncer.py`): URIs sync down to the
+        local staging area first, so an experiment started anywhere
+        restores anywhere the storage is reachable."""
+        if "://" in path:
+            import hashlib
+
+            from ray_tpu.tune.syncer import get_syncer
+
+            # stage keyed by the FULL URI: two buckets with same-named
+            # experiments must not merge into one local dir
+            digest = hashlib.sha1(path.encode()).hexdigest()[:10]
+            local = os.path.join(
+                os.path.expanduser("~"), "ray_tpu_results", "_synced",
+                f"{path.rstrip('/').rsplit('/', 1)[-1]}-{digest}")
+            get_syncer(path).sync_down(path, local)
+            path = local
         return RestoredTuner(path, trainable, tune_config)
 
 

@@ -317,3 +317,105 @@ class TPESearcher(Searcher):
             _set_path(config, path, value)
         self._live[trial_id] = config
         return config
+
+
+class AskTellSearcher(Searcher):
+    """Adapter for external ask/tell optimizers (reference:
+    `tune/search/optuna/optuna_search.py` — the integration seam the
+    reference wraps Optuna/BOHB/Ax through).
+
+    Two optimizer protocols are accepted:
+
+    * **Optuna study**: detected by ``ask``/``tell`` + ``direction``
+      attributes.  ``suggest`` calls ``study.ask(distributions)`` built
+      from the Tune param_space (Float -> FloatDistribution, Integer ->
+      IntDistribution, Categorical -> CategoricalDistribution) and
+      completion calls ``study.tell(trial, value)``.
+    * **Plain ask/tell**: any object with ``ask(param_space) -> config``
+      and ``tell(config, score)`` where score is normalized so HIGHER is
+      better (the adapter flips minimize-mode values).
+
+    Sampled-domain callables (``tune.sample_from``-style) are resolved
+    here either way, so the optimizer only sees concrete dimensions.
+    """
+
+    def __init__(self, optimizer, metric: Optional[str] = None,
+                 mode: Optional[str] = None, n_initial_points: int = 8,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._opt = optimizer
+        self._is_optuna = hasattr(optimizer, "direction") or (
+            type(optimizer).__module__.startswith("optuna"))
+        self._live: Dict[str, Any] = {}  # trial_id -> (handle, config)
+        self.param_space: Dict[str, Any] = {}
+        # the controller caps default concurrency at a model-based
+        # searcher's warmup width (tune_controller.run) — expose it so an
+        # unbounded budget doesn't ask for everything before any tell
+        self.n_initial = n_initial_points
+        self._rng = random.Random(seed)
+
+    # ------------------------------------------------------------ optuna
+
+    def _optuna_distributions(self):
+        import optuna
+
+        dists = {}
+        for name, dom in self.param_space.items():
+            if isinstance(dom, Float):
+                dists[name] = optuna.distributions.FloatDistribution(
+                    dom.lower, dom.upper, log=dom.log)
+            elif isinstance(dom, Integer):
+                dists[name] = optuna.distributions.IntDistribution(
+                    dom.lower, dom.upper - 1)
+            elif isinstance(dom, Categorical):
+                dists[name] = optuna.distributions.CategoricalDistribution(
+                    list(dom.categories))
+        return dists
+
+    # ----------------------------------------------------------- Searcher
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        fixed = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, (Float, Integer, Categorical)):
+                continue  # the optimizer's dimensions
+            if isinstance(v, dict) and "grid_search" in v:
+                raise ValueError(
+                    "AskTellSearcher does not combine with grid_search "
+                    "markers — enumerate the grid as a Categorical or use "
+                    "BasicVariantGenerator")
+            if isinstance(v, Domain):
+                # sample_from / custom domains resolve HERE — the
+                # optimizer only sees concrete F/I/C dimensions
+                fixed[k] = v.sample(self._rng)
+            else:
+                fixed[k] = v
+        if self._is_optuna:
+            handle = self._opt.ask(self._optuna_distributions())
+            config = dict(fixed)
+            config.update(handle.params)
+        else:
+            sampled = self._opt.ask({
+                k: v for k, v in self.param_space.items()
+                if isinstance(v, (Float, Integer, Categorical))})
+            handle = None
+            config = dict(fixed)
+            config.update(sampled)
+        self._live[trial_id] = (handle, dict(config))
+        return config
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None) -> None:
+        entry = self._live.pop(trial_id, None)
+        if entry is None or not result:
+            return
+        handle, config = entry
+        v = result.get(self.metric) if self.metric else None
+        if v is None:
+            return
+        if self._is_optuna:
+            # optuna honours the study's own direction — pass raw
+            self._opt.tell(handle, float(v))
+        else:
+            score = float(v) if self.mode == "max" else -float(v)
+            self._opt.tell(config, score)

@@ -81,6 +81,10 @@ class TuneController:
         self._search_budget = 0
         self.exp_dir = run_config.resolved_storage_path()
         os.makedirs(self.exp_dir, exist_ok=True)
+        # URI storage (reference: tune/syncer.py): mirror the experiment
+        # dir to the remote target with every state save + at run end
+        self._sync_uri = (run_config.storage_uri()
+                          if hasattr(run_config, "storage_uri") else None)
         if param_space is None:
             # restore path: the caller installs a pre-built trial list
             self.trials: List[Trial] = []
@@ -313,6 +317,16 @@ class TuneController:
             json.dump(state, f, indent=1, default=str)
         os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
         self._last_state_save = time.time()
+        if self._sync_uri:
+            from ray_tpu.tune.syncer import get_syncer
+
+            try:
+                get_syncer(self._sync_uri).sync_up(self.exp_dir,
+                                                   self._sync_uri)
+            except Exception:  # noqa: BLE001 — sync failures must not
+                import traceback  # kill the run; next save retries
+
+                traceback.print_exc()
 
     def results(self) -> List[Result]:
         out = []
