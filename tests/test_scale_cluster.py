@@ -1,0 +1,33 @@
+"""8-node fake-cluster flood (separate module: it owns the runtime
+for the whole process — the embedded ray_shared fixture and a cluster
+attach cannot coexist)."""
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_eight_node_cluster_flood():
+    """8 fake nodes: a 2k-task flood spills across every node and all
+    results come home."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1})
+    try:
+        for _ in range(7):
+            c.add_node(num_cpus=1, object_store_mb=32)
+        c.wait_for_nodes(8)
+        c.connect()
+
+        @ray_tpu.remote(num_cpus=1)
+        def whoami(i):
+            import os
+
+            return (i, os.environ.get("RAY_TPU_NODE_ID", ""))
+
+        refs = [whoami.remote(i) for i in range(2_000)]
+        out = ray_tpu.get(refs, timeout=300)
+        assert sorted(i for i, _ in out) == list(range(2_000))
+        nodes_used = {nid for _, nid in out if nid}
+        assert len(nodes_used) >= 4, (
+            f"flood stayed on {len(nodes_used)} node(s) — spillback "
+            "isn't spreading")
+    finally:
+        c.shutdown()
