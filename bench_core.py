@@ -47,6 +47,16 @@ def main():
 
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
     results = {}
+    # Provenance: vs_reference compares against m4.16xlarge-class numbers
+    # (BASELINE.md); absolute rows are only comparable across runs on the
+    # same host class, so record what this one looked like.
+    results["bench_env"] = {
+        "host_cpus": os.cpu_count(),
+        "note": ("vs_reference baselines were recorded on an "
+                 "m4.16xlarge-class host; compare absolute rows only "
+                 "against runs on the same host (see host_memcpy_gib_per_s "
+                 "for a same-run hardware yardstick)"),
+    }
 
     # Context for the GiB/s rows: the reference's 18.8 GiB/s was measured
     # on an m4.16xlarge (64 cores); put throughput is one memcpy, so this
@@ -64,6 +74,46 @@ def main():
         if baseline:
             results[name]["vs_reference"] = round(value / baseline, 2)
         print(json.dumps({"metric": name, **results[name]}), flush=True)
+
+    # ---- frame codec (control-plane framing, no cluster involved) ----
+    # Measures scan+decode of coalesced frame trains — the raylet's
+    # per-wakeup receive work — independently of scheduler changes.
+    import pickle as _pickle
+
+    from ray_tpu.core import protocol as _protocol
+
+    _codec_msgs = [
+        {"t": "done", "task_id": b"x" * 16, "ok": True,
+         "inline": {"aa" * 10: b"y" * 64}, "stored": [], "sizes": {},
+         "contains": {}}
+        for _ in range(64)
+    ]
+    _codec_stream = bytes(_protocol.encode_frames(
+        [_pickle.dumps(m, protocol=5) for m in _codec_msgs]))
+    _codec_rounds = max(20, int(200 * scale))
+    _n_frames = 0
+    _t0 = time.perf_counter()
+    for _ in range(_codec_rounds):
+        _buf = bytearray(_codec_stream)
+        _sink = []
+        _protocol.drain_frames(_buf, _sink.append, lambda: True)
+        _n_frames += len(_sink)
+    record("proto_frames_per_s", _n_frames / (time.perf_counter() - _t0))
+    results["proto_codec"] = {
+        "value": _protocol._codec.name,
+        "unit": "codec (RAY_TPU_DISABLE_NATIVE_CODEC=1 forces python)"}
+    print(json.dumps({"metric": "proto_codec", **results["proto_codec"]}),
+          flush=True)
+
+    # Warm the worker pool BEFORE any timed row: prestarted workers spend
+    # seconds importing Python+numpy, and on a small host that contention
+    # otherwise lands on whichever rows run first (put/get are op-overhead
+    # benchmarks, not import-contention benchmarks).
+    @ray_tpu.remote
+    def _warm():
+        return b"ok"
+
+    ray_tpu.get([_warm.remote() for _ in range(16)])
 
     # ---- object store put/get (small objects: op overhead) ----
     n = int(3000 * scale)
@@ -110,7 +160,7 @@ def main():
     def nop():
         return b"ok"
 
-    # warm the worker pool so spawn cost isn't measured
+    # pool is warm (init above); prime this function's dispatch path
     ray_tpu.get([nop.remote() for _ in range(8)])
 
     n = int(1000 * scale)

@@ -70,10 +70,11 @@ class ClientWorker(Worker):
     # Worker.get/put/wait/submit use _send/_request like worker mode does.
 
     def _read_loop(self):
+        reader = protocol.FrameReader(self.sock)
         while True:
             try:
-                msg = protocol.recv_msg(self.sock)
-            except OSError:
+                msg = reader.recv_msg()
+            except (OSError, protocol.ProtocolError):
                 msg = None
             if msg is None:
                 err = ConnectionError("raylet connection lost")

@@ -99,12 +99,15 @@ config.define("temp_dir", str, "/tmp/ray_tpu", "Session root directory.")
 config.define("prestart_workers", bool, True,
               "Start the worker pool eagerly at init (reference raylet "
               "prestarts workers, main.cc:48).")
-config.define("dispatch_batch_max", int, 16,
+config.define("dispatch_batch_max", int, 64,
               "Max same-shape normal tasks dispatched to one worker in a "
               "single coalesced frame (they execute sequentially and hold "
               "ONE task's resources; the worker requeues unstarted ones if "
-              "its current task blocks).  1 disables batching.")
-config.define("actor_pipeline_depth", int, 8,
+              "its current task blocks).  1 disables batching.  Sized with "
+              "the native frame codec: a 64-frame train is one sendall + "
+              "one scan, and blocked batches hand their tail back, so the "
+              "latency cost of depth is bounded by one task's runtime.")
+config.define("actor_pipeline_depth", int, 32,
               "Max calls pipelined to a SYNC max_concurrency=1 actor ahead "
               "of completion (the worker's single executor thread runs "
               "them one at a time, so effective concurrency stays 1; this "

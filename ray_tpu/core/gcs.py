@@ -877,9 +877,13 @@ class GcsServer:
     def _serve_conn(self, sock: socket.socket):
         send_lock = threading.Lock()
         push_cb = None
+        reader = protocol.FrameReader(sock)
         try:
             while True:
-                msg = protocol.recv_msg(sock)
+                try:
+                    msg = reader.recv_msg()
+                except protocol.ProtocolError:
+                    break  # desynced peer: drop the connection
                 if msg is None:
                     break
                 t = msg.get("t")
@@ -962,10 +966,11 @@ class GcsClient:
         self._reader.start()
 
     def _read_loop(self):
+        reader = protocol.FrameReader(self._sock)
         while True:
             try:
-                msg = protocol.recv_msg(self._sock)
-            except OSError:
+                msg = reader.recv_msg()
+            except (OSError, protocol.ProtocolError):
                 msg = None
             if msg is None:
                 was_closed = self._closed

@@ -376,8 +376,12 @@ class Raylet:
         self._wake_r.setblocking(False)
         self._inbox: deque = deque()
         self._inbox_lock = threading.Lock()
-        # wake coalescing: a submission storm sends ONE wake byte per loop
-        # drain instead of one syscall per call_async
+        # Wake elision: _wake_armed=True means the loop is GUARANTEED to
+        # drain the inbox without a wake byte — either a byte is already in
+        # flight, or the loop is awake and will re-check the inbox before
+        # blocking in select (it disarms under the lock right before a
+        # blocking select).  A submission storm while the loop is busy
+        # costs ZERO syscalls instead of one send per call_async.
         self._wake_armed = False
 
         self._sel = selectors.DefaultSelector()
@@ -388,6 +392,13 @@ class Raylet:
                                ("accept", None))
 
         # state (event-thread owned)
+        # Batched-drain context: while a frame train is being drained,
+        # actor pumps and request replies are deferred/coalesced so one
+        # wakeup's worth of messages costs one pump per actor and one
+        # sendall per conn instead of one each per frame.
+        self._drain_depth = 0
+        self._pending_pumps: "dict[ActorID, _ActorState]" = {}
+        self._pending_replies: "dict[int, tuple]" = {}  # id(conn) -> (conn, [msgs])
         self._workers: Dict[socket.socket, _WorkerConn] = {}
         self._idle: Dict[str, deque] = {}  # profile -> deque[_WorkerConn]
         self._spawning: Dict[str, int] = {}
@@ -417,6 +428,10 @@ class Raylet:
         # (each yielded item is relayed so the consumer-side stream state
         # advances — covers actor-routed and node-affinity streaming tasks).
         self._foreign_streams: Dict[TaskID, str] = {}
+        # auto-free grace queue (see _maybe_free): FIFO of (deadline, oid)
+        # swept by a single repeating timer instead of a timer per object
+        self._free_queue: deque = deque()
+        self._free_sweep_armed = False
         # lineage bookkeeping (bounded; see submit_task)
         self._lineage_count = 0
         self._reconstructing: set = set()
@@ -503,6 +518,9 @@ class Raylet:
 
     def _run(self):
         while not self._shutdown:
+            # The inbox is drained every iteration (not only on wake bytes:
+            # elided wakes rely on this — see _wake_armed).
+            self._drain_inbox()
             # Debounced scheduling: submit/done storms request a schedule
             # pass via the flag; ONE queue scan runs per loop iteration
             # instead of one per message (a 2000-task burst is otherwise an
@@ -511,6 +529,14 @@ class Raylet:
                 self._need_schedule = False
                 self._safe(self._schedule_now)
             timeout = 0.0 if self._need_schedule else self._next_timer_delay()
+            if timeout != 0.0:
+                with self._inbox_lock:
+                    if self._inbox:
+                        timeout = 0.0  # drained next iteration; stay armed
+                    else:
+                        # about to block: from here on a caller must send a
+                        # wake byte to interrupt the select
+                        self._wake_armed = False
             events = self._sel.select(timeout)
             now = time.monotonic()
             while self._timers and self._timers[0][0] <= now:
@@ -531,8 +557,11 @@ class Raylet:
                         self._wake_r.recv(4096)
                     except OSError:
                         pass
+                    # The loop is awake: callers can skip wake bytes until
+                    # it disarms again right before the next blocking
+                    # select (the loop-top drain picks their work up).
                     with self._inbox_lock:
-                        self._wake_armed = False
+                        self._wake_armed = True
                     self._drain_inbox()
                 elif kind == "worker":
                     # Never let a malformed message kill the event thread; a
@@ -618,6 +647,47 @@ class Raylet:
 
     _drain_frames = staticmethod(protocol.drain_frames)
 
+    # ---- batched drain context ----
+    # A frame train drained from one socket wakeup is handled under this
+    # context: per-frame actor pumps collapse into one pump per actor and
+    # per-frame replies into one coalesced sendall per conn, AFTER the whole
+    # train is processed (one schedule pass — the _need_schedule flag — was
+    # already per-batch).
+
+    def _begin_drain(self):
+        self._drain_depth += 1
+
+    def _end_drain(self):
+        self._drain_depth -= 1
+        if self._drain_depth:
+            return
+        while self._pending_pumps:
+            _, actor = self._pending_pumps.popitem()
+            self._safe(lambda a=actor: self._pump_actor(a))
+        while self._pending_replies:
+            _, (conn, msgs) = self._pending_replies.popitem()
+            try:
+                conn.send_many(msgs)
+            except OSError:
+                pass  # conn died mid-drain; its death path handles cleanup
+
+    def _queue_reply(self, conn: _WorkerConn, msg: dict):
+        """Reply to a worker request: coalesced per drain, direct otherwise."""
+        if self._drain_depth:
+            entry = self._pending_replies.get(id(conn))
+            if entry is None:
+                self._pending_replies[id(conn)] = (conn, [msg])
+            else:
+                entry[1].append(msg)
+        else:
+            conn.send(msg)
+
+    def _request_pump(self, actor: "_ActorState"):
+        if self._drain_depth:
+            self._pending_pumps[actor.actor_id] = actor
+        else:
+            self._pump_actor(actor)
+
     def _on_worker_readable(self, conn: _WorkerConn):
         """Buffered frame reader: ONE recv drains everything the kernel has
         for this socket (workers coalesce done bursts into frame trains),
@@ -631,10 +701,14 @@ class Raylet:
             self._on_worker_death(conn)
             return
         conn.rbuf += data
-        self._drain_frames(
-            conn.rbuf,
-            lambda msg: self._handle_worker_msg(conn, msg),
-            lambda: self._workers.get(conn.sock) is conn)
+        self._begin_drain()
+        try:
+            self._drain_frames(
+                conn.rbuf,
+                lambda msg: self._handle_worker_msg(conn, msg),
+                lambda: self._workers.get(conn.sock) is conn)
+        finally:
+            self._end_drain()
         if self._workers.get(conn.sock) is conn:
             return
         # The conn left _workers mid-train: either it died (socket closed,
@@ -647,10 +721,14 @@ class Raylet:
         if kind == "peer" and conn.rbuf:
             peer.rbuf += conn.rbuf
             conn.rbuf = bytearray()
-            self._drain_frames(
-                peer.rbuf,
-                lambda msg: self._handle_peer_msg(peer, msg),
-                lambda: self._peer_alive(peer))
+            self._begin_drain()
+            try:
+                self._drain_frames(
+                    peer.rbuf,
+                    lambda msg: self._handle_peer_msg(peer, msg),
+                    lambda: self._peer_alive(peer))
+            finally:
+                self._end_drain()
 
     def _peer_alive(self, peer) -> bool:
         try:
@@ -920,7 +998,18 @@ class Raylet:
     # --------------------------------------------------------------- messages
 
     def _handle_worker_msg(self, conn: _WorkerConn, msg: dict):
+        # Hot-path types first: a drained train is almost entirely done /
+        # request / submit frames (the rest are connection lifecycle).
         t = msg["t"]
+        if t == "done":
+            self._on_task_done(conn, msg)
+            return
+        if t == "request":
+            self._handle_request(conn, msg)
+            return
+        if t == "submit":
+            self.submit_task(msg["spec"])
+            return
         if t == "peer_hello":
             # Another raylet dialed us: promote the conn to a peer channel.
             peer = _PeerConn(conn.sock, msg["node_id"])
@@ -947,8 +1036,6 @@ class Raylet:
             ]
             self._return_worker(conn)
             self._schedule()
-        elif t == "done":
-            self._on_task_done(conn, msg)
         elif t == "requeue":
             # the worker's current task blocked (nested get/wait) with
             # unstarted batch members queued behind it — take them back so
@@ -967,10 +1054,6 @@ class Raylet:
             self._on_stream_item(msg)
         elif t == "ref_events":
             self.apply_ref_events(msg["events"], conn)
-        elif t == "submit":
-            self.submit_task(msg["spec"])
-        elif t == "request":
-            self._handle_request(conn, msg)
 
     def _on_task_done(self, conn: _WorkerConn, msg: dict):
         tid = msg.get("task_id")
@@ -1050,7 +1133,10 @@ class Raylet:
             # an arbitrary idle worker with no actor instance.
             self._enqueue_ready(spec)
         if actor is not None and actor.state == "alive":
-            self._pump_actor(actor)
+            # Deferred under a batched drain: N dones from one wakeup pump
+            # the actor ONCE (one coalesced dispatch train) instead of N
+            # single-message sendalls.
+            self._request_pump(actor)
         self._schedule()
 
     # --------------------------------------------------------------- cluster
@@ -1326,10 +1412,14 @@ class Raylet:
             self._drop_peer(peer)
             return
         peer.rbuf += data
-        self._drain_frames(
-            peer.rbuf,
-            lambda msg: self._handle_peer_msg(peer, msg),
-            lambda: self._peer_alive(peer))
+        self._begin_drain()
+        try:
+            self._drain_frames(
+                peer.rbuf,
+                lambda msg: self._handle_peer_msg(peer, msg),
+                lambda: self._peer_alive(peer))
+        finally:
+            self._end_drain()
 
     def _handle_peer_msg(self, peer: _PeerConn, msg: dict):
         t = msg["t"]
@@ -1822,8 +1912,27 @@ class Raylet:
         if oid in self._dep_index or oid in self._object_waiters:
             return
         st.free_armed = True
-        self.add_timer(config.ref_free_grace_s,
-                       lambda: self._free_if_unreferenced(oid))
+        # Batched grace queue: a 10k-task fan-out frees 10k objects in a
+        # burst — one timer per object is 10k heap pushes now and 10k
+        # callback pops at grace expiry.  The grace period is a constant,
+        # so deadlines are monotonic: a FIFO deque + ONE sweeper timer
+        # gives the same semantics for O(1) per free.
+        self._free_queue.append((time.monotonic() + config.ref_free_grace_s,
+                                 oid))
+        if not self._free_sweep_armed:
+            self._free_sweep_armed = True
+            self.add_timer(config.ref_free_grace_s, self._sweep_free_queue)
+
+    def _sweep_free_queue(self):
+        now = time.monotonic()
+        q = self._free_queue
+        while q and q[0][0] <= now:
+            _, oid = q.popleft()
+            self._safe(lambda o=oid: self._free_if_unreferenced(o))
+        if q:
+            self.add_timer(max(0.0, q[0][0] - now), self._sweep_free_queue)
+        else:
+            self._free_sweep_armed = False
 
     def _free_if_unreferenced(self, oid: ObjectID):
         st = self._objects.get(oid)
@@ -2903,8 +3012,10 @@ class Raylet:
         op = msg["op"]
 
         def reply(ok=True, value=None, error=None):
-            conn.send({"t": "reply", "rid": rid, "ok": ok, "value": value,
-                       "error": error})
+            # _queue_reply coalesces every reply generated by one drained
+            # train into a single sendall per conn.
+            self._queue_reply(conn, {"t": "reply", "rid": rid, "ok": ok,
+                                     "value": value, "error": error})
 
         def deferred_reply(value):
             # A worker that timed out already popped its pending entry, so a
@@ -2912,8 +3023,8 @@ class Raylet:
             # swallowed here.
             conn.request_cancels.pop(rid, None)
             try:
-                conn.send({"t": "reply", "rid": rid, "ok": True,
-                           "value": value})
+                self._queue_reply(conn, {"t": "reply", "rid": rid,
+                                         "ok": True, "value": value})
             except OSError:
                 pass
 
@@ -3299,7 +3410,16 @@ class Raylet:
             **extra,
         }
         self._task_events.append(ev)
-        self._task_states[spec.task_id] = ev
+        states = self._task_states
+        # pop+reinsert: dict order becomes least-recently-UPDATED first, so
+        # the overflow eviction below drops stale finished tasks before a
+        # long-running task that just reported RUNNING
+        states.pop(spec.task_id, None)
+        states[spec.task_id] = ev
+        if len(states) > config.task_event_buffer_size:
+            # bound the per-task state map like the event deque: a driver
+            # submitting forever must not grow raylet memory without limit
+            states.pop(next(iter(states)))
 
     def state_snapshot(self) -> dict:
         return {

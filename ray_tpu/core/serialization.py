@@ -138,7 +138,11 @@ def deserialize(data: memoryview) -> Any:
         (l,) = struct.unpack_from("<Q", data, off)
         lens.append(l)
         off += 8
-    pickled = bytes(data[off : off + plen])
+    # No bytes() copy of the pickle stream: loads accepts any buffer, and
+    # the meta segment can reach inline_object_max_bytes (100KB) — on the
+    # 1MB get path this plus the out-of-band views below keeps the read
+    # fully zero-copy over the shm arena.
+    pickled = data[off : off + plen]
     off = _aligned(off + plen)
     bufs = []
     for l in lens:

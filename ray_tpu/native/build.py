@@ -1,43 +1,95 @@
 """Lazy build of the native components.
 
-The shared library is compiled on first import (and cached next to the
+Shared libraries are compiled on first use (and cached next to the
 sources).  We deliberately avoid setuptools here: the native runtime has no
 Python-API dependency (pure ``extern "C"`` + ctypes), so a single g++
-invocation suffices and works in hermetic environments.
+invocation per library suffices and works in hermetic environments.
+
+Two callers with different failure policies share this module:
+
+  * the shm object store (``lib_path("store")``) — a hard dependency of
+    the data plane; build failures propagate as ``NativeBuildError``.
+  * the frame codec (``lib_path("codec")``) — a pure optimization of the
+    control plane; ``try_lib_path`` returns None (with a one-time warning)
+    so callers fall back to the pure-Python codec when g++ is absent.
 """
 
 from __future__ import annotations
 
 import os
 import subprocess
+import sys
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "src", "object_store.cc")
-_LIB = os.path.join(_DIR, "librt_store.so")
 _lock = threading.Lock()
+
+# name -> (source file under src/, output .so)
+_LIBS = {
+    "store": ("object_store.cc", "librt_store.so"),
+    "codec": ("frame_codec.cc", "librt_codec.so"),
+}
+
+_warned: set = set()
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def lib_path() -> str:
-    """Return path to librt_store.so, building it if stale or missing."""
+def _build(src: str, lib: str):
+    # Per-pid temp name: two processes racing to build must not scribble
+    # over each other's half-written .so (os.replace keeps the swap atomic).
+    tmp = f"{lib}.tmp{os.getpid()}"
+    cmd = [
+        "g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
+        "-o", tmp, src,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except (FileNotFoundError, OSError) as e:
+        raise NativeBuildError(f"native build failed ({e}): {' '.join(cmd)}")
+    if proc.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise NativeBuildError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+        )
+    os.replace(tmp, lib)
+
+
+def lib_path(name: str = "store") -> str:
+    """Return path to the named native library, building if stale/missing.
+
+    Raises ``NativeBuildError`` when the compiler is unavailable or the
+    build fails.
+    """
+    try:
+        src_name, lib_name = _LIBS[name]
+    except KeyError:
+        raise NativeBuildError(f"unknown native library {name!r}") from None
+    src = os.path.join(_DIR, "src", src_name)
+    lib = os.path.join(_DIR, lib_name)
     with _lock:
         if (
-            not os.path.exists(_LIB)
-            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            not os.path.exists(lib)
+            or os.path.getmtime(lib) < os.path.getmtime(src)
         ):
-            tmp = _LIB + ".tmp"
-            cmd = [
-                "g++", "-std=c++17", "-O3", "-fPIC", "-shared", "-pthread",
-                "-o", tmp, _SRC,
-            ]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
-                )
-            os.replace(tmp, _LIB)
-    return _LIB
+            _build(src, lib)
+    return lib
+
+
+def try_lib_path(name: str) -> "str | None":
+    """``lib_path`` that degrades to None (warn once) instead of raising —
+    for native components with a pure-Python fallback."""
+    try:
+        return lib_path(name)
+    except NativeBuildError as e:
+        if name not in _warned:
+            _warned.add(name)
+            sys.stderr.write(
+                f"[ray_tpu] native {name} library unavailable, using "
+                f"pure-Python fallback: {e}\n")
+        return None
