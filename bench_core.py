@@ -178,6 +178,32 @@ def main():
 
     record("tasks_async_per_s", timed(n, tasks_async), baseline=11527.5)
 
+    # ---- task-event export overhead (observability tax) ----
+    # Same loop with the export pipeline off (RAY_TPU_TASK_EVENTS=0
+    # equivalent): the row tracks what fraction of tasks_async throughput
+    # the task-event export costs, so observability regressions show up in
+    # BENCH_CORE.json like any perf regression.  Interleaved best-of-2 per
+    # mode: on a noisy shared host a single A/B pair mostly measures the
+    # host, not the export.
+    on_rate, off_rate = 0.0, 0.0
+    events_before = ray_tpu.config.task_events
+    try:
+        for _ in range(2):
+            ray_tpu.config.task_events = True
+            on_rate = max(on_rate, timed(n, tasks_async))
+            ray_tpu.config.task_events = False
+            off_rate = max(off_rate, timed(n, tasks_async))
+    finally:
+        ray_tpu.config.task_events = events_before
+    record("tasks_async_no_task_events_per_s", off_rate)
+    results["task_events_overhead"] = {
+        "value": round(max(0.0, 1.0 - on_rate / max(off_rate, 1e-9)), 4),
+        "unit": ("fraction of tasks_async throughput lost with task-event "
+                 "export enabled (toggle: RAY_TPU_TASK_EVENTS)"),
+    }
+    print(json.dumps({"metric": "task_events_overhead",
+                      **results["task_events_overhead"]}), flush=True)
+
     # ---- actor calls ----
     @ray_tpu.remote
     class A:

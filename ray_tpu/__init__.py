@@ -111,6 +111,15 @@ def init(
 def shutdown():
     if not is_initialized():
         return
+    # Final synchronous metrics flush BEFORE the worker goes away (the
+    # daemon flusher would drop the last window) + flusher/producer reset
+    # so a re-init in this process doesn't double-report.
+    try:
+        from ray_tpu.util.metrics import shutdown_metrics
+
+        shutdown_metrics()
+    except Exception:  # noqa: BLE001
+        pass
     w = global_worker()
     clear_worker()
     if hasattr(w, "shutdown"):
@@ -232,31 +241,26 @@ def nodes() -> List[dict]:
 
 
 def timeline(filename: Optional[str] = None):
-    """Dump task state events as chrome://tracing JSON
-    (reference: `python/ray/_private/state.py:416`)."""
+    """Dump the CLUSTER-WIDE task timeline as chrome://tracing JSON
+    (reference: `python/ray/_private/state.py:416`), fed by the GCS
+    task-event table: per-task queue-wait vs run sub-slices, open-ended
+    slices for still-running tasks (they are not silently dropped), and —
+    when tracing is enabled — flow arrows from the driver's submit spans
+    to the matching run slices."""
     import json
 
+    from ray_tpu.util import tracing as _tracing
+    from ray_tpu.util.state import build_timeline, raw_task_events
+
     w = global_worker()
-    if w.mode == "client":
-        snap = w._request("state_snapshot")
-    else:
-        snap = w.raylet.call(w.raylet.state_snapshot).result()
-    events = []
-    starts = {}
-    for ev in snap["events"]:
-        if ev["state"] == "RUNNING":
-            starts[ev["task_id"]] = ev
-        elif ev["state"] in ("FINISHED", "FAILED") and ev["task_id"] in starts:
-            s = starts.pop(ev["task_id"])
-            events.append({
-                "cat": "task", "name": s["name"], "ph": "X",
-                "ts": s["time"] * 1e6, "dur": (ev["time"] - s["time"]) * 1e6,
-                "pid": s.get("pid", 0), "tid": s.get("pid", 0),
-            })
+    events = [] if w.mode == "local" else raw_task_events()
+    spans = (_tracing.read_spans(name_prefix="task.submit")
+             if _tracing.tracing_enabled() else None)
+    trace = build_timeline(events, spans=spans)
     if filename:
         with open(filename, "w") as f:
-            json.dump(events, f)
-    return events
+            json.dump(trace, f)
+    return trace
 
 
 # Convenience namespaced access (lazy imports to keep `import ray_tpu` light).

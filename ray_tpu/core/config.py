@@ -117,6 +117,29 @@ config.define("health_check_period_s", float, 1.0, "")
 config.define("task_event_buffer_size", int, 10000,
               "Max buffered task state events for the state API.")
 
+# --- observability -----------------------------------------------------------
+config.define("task_events", bool, True,
+              "Export task lifecycle events to the GCS task-event table "
+              "(reference: GCS task-event backend feeding list_tasks / "
+              "ray.timeline).  RAY_TPU_TASK_EVENTS=0 disables the export "
+              "(local ring buffers keep working).")
+config.define("task_event_flush_interval_s", float, 0.25,
+              "Raylet -> GCS task-event batch flush period.")
+config.define("task_event_batch_max", int, 512,
+              "Flush the task-event export buffer early once it holds this "
+              "many events (piggybacks on the frame-train drain cadence).")
+config.define("task_event_export_buffer", int, 4096,
+              "Ring-buffer cap for not-yet-flushed task events; overflow "
+              "drops the OLDEST events and bumps num_dropped — export "
+              "backpressure never blocks dispatch.")
+config.define("task_events_max_per_job", int, 20000,
+              "GCS-side cap per job: max retained task events AND max "
+              "tracked per-task states (oldest evicted first).")
+config.define("internal_metrics_interval_s", float, 1.0,
+              "Flush period for the runtime's own ray_tpu_internal_* "
+              "metrics (queue depth, dispatch latency, store bytes, codec "
+              "counters) into the metrics KV -> /metrics.  0 disables.")
+
 # --- tensor plane -----------------------------------------------------------
 config.define("mesh_default_axes", str, "dp,tp", "")
 config.define("enable_pallas", bool, True,

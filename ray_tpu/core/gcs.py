@@ -32,6 +32,7 @@ import socket
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol
@@ -80,6 +81,13 @@ class GcsCore:
         # cluster placement groups: pg_id -> {bundles, strategy,
         #   assignments: {bundle_idx: node_id}, origin, pending, state}
         self._cluster_pgs: Dict[str, dict] = {}
+        # Task-event table (reference: the GCS task-event backend behind
+        # `list_tasks`/`ray.timeline`, `python/ray/util/state/api.py:1009`):
+        # job_id -> {"events": deque (raw log, timeline), "tasks": dict
+        # task_id(hex) -> latest event (state API)}.  Bounded per job
+        # (config.task_events_max_per_job), soft state — never persisted.
+        self._task_events: Dict[str, dict] = {}
+        self._task_events_dropped = 0  # raylet-side ring-buffer drops
         # oid(hex) -> {nodes: set[node_id], size, inline}
         self._objects: Dict[str, dict] = {}
         # oid(hex) -> set of watcher node_ids (want a push when located)
@@ -804,6 +812,85 @@ class GcsCore:
                 self._object_watchers.setdefault(oid, set()).add(watcher)
             return {"nodes": [], "size": 0, "inline": False}
 
+    # ----------------------------------------------------------- task events
+
+    def add_task_events(self, node_id: str, events: List[dict],
+                        dropped: int = 0):
+        """Batch append from one raylet's export ring buffer.  ``dropped``
+        is how many events that raylet shed to backpressure since its last
+        flush (the buffer never blocks dispatch — it drops and counts)."""
+        cap = max(1, config.task_events_max_per_job)
+        with self._lock:
+            self._task_events_dropped += dropped
+            last_job, tasks, log = None, None, None
+            for ev in events:
+                job = ev.get("job_id") or "driver"
+                if job != last_job:  # batches are almost always one job
+                    slot = self._task_events.get(job)
+                    if slot is None:
+                        slot = {"events": deque(maxlen=cap), "tasks": {}}
+                        self._task_events[job] = slot
+                    last_job, tasks, log = job, slot["tasks"], slot["events"]
+                log.append(ev)
+                # pop+reinsert keeps dict order least-recently-updated
+                # first, so cap overflow evicts stale finished tasks
+                tid = ev["task_id"]
+                tasks.pop(tid, None)
+                tasks[tid] = ev
+                if len(tasks) > cap:
+                    tasks.pop(next(iter(tasks)))
+
+    def _job_slots(self, job_id: Optional[str]) -> List[dict]:
+        if job_id is not None:
+            slot = self._task_events.get(job_id)
+            return [slot] if slot else []
+        return list(self._task_events.values())
+
+    def list_task_events(self, job_id: Optional[str] = None,
+                         state: Optional[str] = None,
+                         limit: int = 1000) -> List[dict]:
+        """Latest known state per task, cluster-wide (newest-updated
+        first).  ``limit`` applies at the source."""
+        with self._lock:
+            rows: List[dict] = []
+            for slot in self._job_slots(job_id):
+                rows.extend(slot["tasks"].values())
+        rows.sort(key=lambda ev: ev.get("time", 0.0), reverse=True)
+        if state is not None:
+            state = state.upper()
+            rows = [ev for ev in rows if ev.get("state") == state]
+        return rows[:max(0, limit)]
+
+    def task_events_raw(self, job_id: Optional[str] = None,
+                        limit: int = 100000) -> List[dict]:
+        """The raw event log (every state transition) — timeline feed."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            rows = []
+            for slot in self._job_slots(job_id):
+                rows.extend(slot["events"])
+        rows.sort(key=lambda ev: ev.get("time", 0.0))
+        return rows[-limit:]
+
+    def summarize_task_events(self, job_id: Optional[str] = None) -> dict:
+        """State -> count over the latest per-task states, plus export-drop
+        and node-coverage accounting."""
+        by_state: Dict[str, int] = {}
+        nodes = set()
+        num_tasks = 0
+        with self._lock:
+            for slot in self._job_slots(job_id):
+                for ev in slot["tasks"].values():
+                    num_tasks += 1
+                    st = ev.get("state", "?")
+                    by_state[st] = by_state.get(st, 0) + 1
+                    if ev.get("node_id"):
+                        nodes.add(ev["node_id"])
+            dropped = self._task_events_dropped
+        return {"by_state": by_state, "num_tasks": num_tasks,
+                "num_dropped": dropped, "nodes": sorted(nodes)}
+
     # ----------------------------------------------------------- snapshot
 
     def state_snapshot(self) -> dict:
@@ -838,6 +925,8 @@ _OPS = {
     "lookup_named_actor", "list_actors",
     "add_object_location", "remove_object_location", "get_object_locations",
     "create_pg", "pg_fragment_ready", "remove_cluster_pg", "pg_info",
+    "add_task_events", "list_task_events", "task_events_raw",
+    "summarize_task_events",
     "state_snapshot",
 }
 
@@ -960,6 +1049,10 @@ class GcsClient:
         self._pending: Dict[int, dict] = {}
         self._push_handler = push_handler
         self._on_disconnect = on_disconnect
+        # Optional latency hook: called as (op, seconds) for every blocking
+        # round-trip (the raylet wires it to its internal
+        # ray_tpu_internal_gcs_rpc_latency_s histogram).
+        self.rpc_observer: Optional[Callable[[str, float], None]] = None
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop,
                                         name="gcs-client", daemon=True)
@@ -1004,6 +1097,7 @@ class GcsClient:
             rid = self._rid
         entry = {"event": threading.Event(), "msg": None}
         self._pending[rid] = entry
+        t0 = time.perf_counter()
         protocol.send_msg(
             self._sock,
             {"t": "request", "rid": rid, "op": op, "args": args, "kw": kw},
@@ -1011,6 +1105,11 @@ class GcsClient:
         if not entry["event"].wait(60.0):
             self._pending.pop(rid, None)
             raise TimeoutError(f"GCS op {op} timed out")
+        if self.rpc_observer is not None:
+            try:
+                self.rpc_observer(op, time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001
+                pass
         msg = entry["msg"]
         if not msg["ok"]:
             raise msg["error"]

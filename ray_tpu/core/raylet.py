@@ -419,6 +419,32 @@ class Raylet:
         self._timer_seq = itertools.count()
         self._task_events: deque = deque(maxlen=config.task_event_buffer_size)
         self._task_states: Dict[TaskID, dict] = {}
+        # Task-event export (reference: the raylet's TaskEventBuffer flushing
+        # to the GCS task-event table): a ring buffer of not-yet-flushed
+        # events, batch-flushed on a timer / drain cadence via one-way GCS
+        # posts.  Overflow drops the OLDEST events and counts them —
+        # export backpressure must never block dispatch.
+        self._task_event_buf: deque = deque()
+        self._task_event_dropped = 0        # since last flush (shipped)
+        self._task_event_dropped_total = 0  # lifetime (metrics)
+        self._task_event_timer_armed = False
+        # Hot-path flag handles: _record_event runs 3x per task; reading
+        # .value off the flag object keeps runtime toggles working (tests /
+        # bench flip config.task_events) without a config __getattr__ per
+        # event.
+        self._flag_task_events = config._flags["task_events"]
+        self._flag_event_cap = config._flags["task_event_export_buffer"]
+        self._flag_state_cap = config._flags["task_event_buffer_size"]
+        # Internal runtime metrics (ray_tpu_internal_*): plain event-thread
+        # counters sampled into util.metrics primitives at flush time.
+        self._im: Optional[Dict[str, object]] = None
+        self._m_frames = 0       # control-plane frames handled
+        self._m_trains = 0       # socket drains (frame trains)
+        self._m_train_bytes = 0
+        self._m_tasks_done = {"FINISHED": 0, "FAILED": 0}
+        self._m_last: Dict[str, float] = {}  # counter deltas at flush
+        if config.internal_metrics_interval_s > 0:
+            self._init_internal_metrics()
         self._need_schedule = False
         self._shutdown = False
         # Streaming generator tasks (reference: streaming generator returns,
@@ -479,6 +505,10 @@ class Raylet:
             self.call_async(
                 lambda: self.add_timer(config.memory_monitor_interval_s,
                                        self._memory_check))
+        if self._im is not None:
+            self.call_async(
+                lambda: self.add_timer(config.internal_metrics_interval_s,
+                                       self._flush_internal_metrics))
 
     # ------------------------------------------------------------------ API
     # Called from the driver thread; closures run on the event thread.
@@ -572,6 +602,7 @@ class Raylet:
                         traceback.print_exc()
                         self._safe(lambda c=conn: self._on_worker_death(c))
         # cleanup
+        self._safe(self.flush_task_events)  # don't lose the last window
         for conn in list(self._workers.values()):
             try:
                 conn.send({"t": "shutdown"})
@@ -670,6 +701,10 @@ class Raylet:
                 conn.send_many(msgs)
             except OSError:
                 pass  # conn died mid-drain; its death path handles cleanup
+        # Task-event export rides the drain cadence: a burst that fills the
+        # batch threshold ships now instead of waiting out the flush timer.
+        if len(self._task_event_buf) >= config.task_event_batch_max:
+            self.flush_task_events()
 
     def _queue_reply(self, conn: _WorkerConn, msg: dict):
         """Reply to a worker request: coalesced per drain, direct otherwise."""
@@ -700,6 +735,10 @@ class Raylet:
         if not data:
             self._on_worker_death(conn)
             return
+        self._m_trains += 1
+        self._m_train_bytes += len(data)
+        if self._im is not None:
+            self._im["train_bytes"].observe(len(data))
         conn.rbuf += data
         self._begin_drain()
         try:
@@ -992,7 +1031,8 @@ class Raylet:
                     )
                     for oid in spec.return_ids():
                         self._object_error(oid, err)
-                    self._record_event(spec, "FAILED", worker_died=True)
+                    self._record_event(spec, "FAILED", worker_died=True,
+                                       error=self._err_summary(err))
         self._schedule()
 
     # --------------------------------------------------------------- messages
@@ -1000,6 +1040,7 @@ class Raylet:
     def _handle_worker_msg(self, conn: _WorkerConn, msg: dict):
         # Hot-path types first: a drained train is almost entirely done /
         # request / submit frames (the rest are connection lifecycle).
+        self._m_frames += 1
         t = msg["t"]
         if t == "done":
             self._on_task_done(conn, msg)
@@ -1083,7 +1124,8 @@ class Raylet:
                 err = msg["error"]
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
-                self._record_event(spec, "FAILED")
+                self._record_event(spec, "FAILED",
+                                   error=self._err_summary(err))
             else:
                 inline: Dict[str, bytes] = msg.get("inline", {})
                 stored: List[str] = msg.get("stored", [])
@@ -1225,6 +1267,8 @@ class Raylet:
         the rebuilt object directory.  A connection dropping again
         mid-handshake just re-enters the reconnect loop."""
         old, self.gcs = self.gcs, new_gcs
+        if self._im is not None:
+            new_gcs.rpc_observer = self._observe_gcs_rpc
         try:
             old.close()
         except Exception:  # noqa: BLE001
@@ -1411,6 +1455,10 @@ class Raylet:
         if not data:
             self._drop_peer(peer)
             return
+        self._m_trains += 1
+        self._m_train_bytes += len(data)
+        if self._im is not None:
+            self._im["train_bytes"].observe(len(data))
         peer.rbuf += data
         self._begin_drain()
         try:
@@ -1422,6 +1470,7 @@ class Raylet:
             self._end_drain()
 
     def _handle_peer_msg(self, peer: _PeerConn, msg: dict):
+        self._m_frames += 1
         t = msg["t"]
         if t == "xtask":
             self._handle_xtask(peer, msg)
@@ -2287,10 +2336,12 @@ class Raylet:
                 err = self._objects[oid].error
                 for rid in spec.return_ids():
                     self._object_error(rid, err)
-                self._record_event(spec, "FAILED", dep_error=True)
+                self._record_event(spec, "FAILED", dep_error=True,
+                                   error=self._err_summary(err))
                 return
-        self._record_event(spec, "PENDING")
         if missing:
+            # QUEUED is recorded by _enqueue_ready once the args resolve
+            self._record_event(spec, "PENDING_ARGS")
             self._waiting[spec.task_id] = (spec, missing)
             for oid in missing:
                 self._dep_index.setdefault(oid, set()).add(spec.task_id)
@@ -2304,6 +2355,8 @@ class Raylet:
         self._schedule()
 
     def _enqueue_ready(self, spec: TaskSpec):
+        spec._queued_t = time.monotonic()  # dispatch-latency metric start
+        self._record_event(spec, "QUEUED")
         if spec.kind == ACTOR_TASK:
             actor = self._actors.get(spec.actor_id)
             if actor is None:
@@ -2315,6 +2368,8 @@ class Raylet:
                 )
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
+                self._record_event(spec, "FAILED",
+                                   error=self._err_summary(err))
                 return
             if actor.state == "dead":
                 err = ActorDiedError(
@@ -2323,6 +2378,8 @@ class Raylet:
                 )
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
+                self._record_event(spec, "FAILED",
+                                   error=self._err_summary(err))
                 return
             actor.queue.append(spec)
             self._pump_actor(actor)
@@ -2726,7 +2783,8 @@ class Raylet:
             for _ in range(max(0, want)):
                 self._spawn_worker(profile)
 
-    def _dispatch_msg(self, spec: TaskSpec, conn: _WorkerConn) -> dict:
+    def _dispatch_msg(self, spec: TaskSpec, conn: _WorkerConn,
+                      running: bool = True) -> dict:
         conn.state = "busy"
         conn.current_task = spec
         conn.task_start_time = time.monotonic()
@@ -2769,7 +2827,10 @@ class Raylet:
                 conn.sent_fns.add(key)
             if len(self._fn_cache) > 512:  # bounded write-through cache
                 self._fn_cache.pop(next(iter(self._fn_cache)))
-        self._record_event(spec, "RUNNING", pid=conn.pid)
+        # Batch followers queue ON the worker behind the head task: they
+        # are DISPATCHED (shipped) but not yet RUNNING.
+        self._record_event(spec, "RUNNING" if running else "DISPATCHED",
+                           pid=conn.pid)
         return {"t": "task", "spec": spec, "arg_values": arg_values,
                 "fn_blob": fn_blob}
 
@@ -2781,7 +2842,8 @@ class Raylet:
         sees ordinary per-task messages (recv_msg splits the frames) and
         runs them in order.  current_task ends as specs[0] — the one the
         worker starts executing first."""
-        msgs = [self._dispatch_msg(s, conn) for s in specs]
+        msgs = [self._dispatch_msg(s, conn, running=(i == 0))
+                for i, s in enumerate(specs)]
         conn.current_task = specs[0]
         conn.send_many(msgs)
 
@@ -3143,7 +3205,19 @@ class Raylet:
                 self.remove_pg(msg["pg_id"])
                 reply()
             elif op == "state_snapshot":
-                reply(value=self.state_snapshot())
+                reply(value=self.state_snapshot(
+                    objects_limit=msg.get("objects_limit", 0)))
+            elif op == "flush_task_events":
+                self.flush_task_events()
+                reply()
+            elif op in ("list_task_events", "summarize_task_events",
+                        "task_events_raw"):
+                # Cluster-wide state reads proxied to the GCS task-event
+                # table; flush first so this node's freshest events count.
+                self.flush_task_events()
+                kw = {k: msg[k] for k in ("job_id", "state", "limit")
+                      if k in msg}
+                reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
             elif op == "kill_actor":
                 self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
                 reply()
@@ -3400,13 +3474,25 @@ class Raylet:
 
     # --------------------------------------------------------------- state
 
+    @staticmethod
+    def _err_summary(err) -> str:
+        try:
+            first = str(err).strip().splitlines()
+            return f"{type(err).__name__}: {first[0] if first else ''}"[:200]
+        except Exception:  # noqa: BLE001
+            return type(err).__name__
+
     def _record_event(self, spec: TaskSpec, state: str, **extra):
+        attempt = spec.max_retries - spec.retries_left
         ev = {
             "task_id": spec.task_id.hex(),
             "name": spec.name,
             "kind": spec.kind,
             "state": state,
             "time": time.time(),
+            "node_id": self.node_id,
+            "job_id": spec.job_id,
+            "attempt": attempt if attempt > 0 else 0,
             **extra,
         }
         self._task_events.append(ev)
@@ -3416,12 +3502,196 @@ class Raylet:
         # long-running task that just reported RUNNING
         states.pop(spec.task_id, None)
         states[spec.task_id] = ev
-        if len(states) > config.task_event_buffer_size:
+        if len(states) > self._flag_state_cap.value:
             # bound the per-task state map like the event deque: a driver
             # submitting forever must not grow raylet memory without limit
             states.pop(next(iter(states)))
+        if state in ("RUNNING", "DISPATCHED"):
+            queued_t = getattr(spec, "_queued_t", None)
+            if queued_t is not None and self._im is not None:
+                spec._queued_t = None
+                self._im["dispatch_latency"].observe(
+                    time.monotonic() - queued_t)
+        elif state in ("FINISHED", "FAILED"):
+            self._m_tasks_done[state] += 1
+        # ---- export to the GCS task-event table ----
+        if not self._flag_task_events.value:
+            return
+        buf = self._task_event_buf
+        buf.append(ev)
+        if len(buf) > self._flag_event_cap.value:
+            buf.popleft()
+            self._task_event_dropped += 1
+            self._task_event_dropped_total += 1
+        if not self._task_event_timer_armed:
+            self._task_event_timer_armed = True
+            self.add_timer(config.task_event_flush_interval_s,
+                           self._task_event_flush_tick)
 
-    def state_snapshot(self) -> dict:
+    def flush_task_events(self):
+        """Ship the export ring buffer to the GCS task-event table (one
+        one-way post; event thread only).  Driver/state-API callers invoke
+        this before querying so a just-finished task is visible."""
+        if not self._task_event_buf and not self._task_event_dropped:
+            return
+        events = list(self._task_event_buf)
+        self._task_event_buf.clear()
+        dropped, self._task_event_dropped = self._task_event_dropped, 0
+        self._gcs_post("add_task_events", self.node_id, events, dropped)
+
+    def _task_event_flush_tick(self):
+        # One-shot timer, re-armed lazily by the next _record_event: an
+        # idle raylet pays nothing for the export pipeline.
+        self._task_event_timer_armed = False
+        self.flush_task_events()
+
+    # ---- internal runtime metrics (ray_tpu_internal_*) ----
+
+    def _init_internal_metrics(self):
+        """Instrument the runtime with the util.metrics primitives under
+        the reserved prefix (reference: the ray_* internal gauges exported
+        by the per-node metrics agent, `metrics_agent.py:375`).  The raylet
+        flushes these itself through the GCS KV metrics namespace — raylet
+        processes have no global worker for the per-process flusher."""
+        from ray_tpu.util import metrics as _metrics
+
+        tags = {"node": self.node_id[:12]}
+
+        def gauge(name, desc):
+            return _metrics.internal_metric(
+                _metrics.Gauge, name, desc,
+                tag_keys=("node",)).set_default_tags(tags)
+
+        def counter(name, desc, tag_keys=("node",)):
+            return _metrics.internal_metric(
+                _metrics.Counter, name, desc,
+                tag_keys=tag_keys).set_default_tags(tags)
+
+        def hist(name, desc, bounds):
+            return _metrics.internal_metric(
+                _metrics.Histogram, name, desc, boundaries=bounds,
+                tag_keys=("node",)).set_default_tags(tags)
+
+        self._im = {
+            "queue_depth": gauge(
+                "ray_tpu_internal_scheduler_queue_depth",
+                "Tasks in the raylet ready queue"),
+            "waiting": gauge(
+                "ray_tpu_internal_scheduler_waiting_tasks",
+                "Tasks blocked on unresolved arguments"),
+            "worker_pool": gauge(
+                "ray_tpu_internal_worker_pool_size",
+                "Pooled (non-actor) worker processes"),
+            "objects": gauge(
+                "ray_tpu_internal_objects_tracked",
+                "Objects tracked by this raylet"),
+            "store_bytes": gauge(
+                "ray_tpu_internal_object_store_bytes_used",
+                "Bytes sealed in the shm object store"),
+            "spilled_bytes": gauge(
+                "ray_tpu_internal_object_store_spilled_bytes",
+                "Bytes spilled from the store to disk"),
+            "tasks_total": counter(
+                "ray_tpu_internal_tasks_total",
+                "Terminal task states seen by this raylet",
+                tag_keys=("node", "state")),
+            "events_dropped": counter(
+                "ray_tpu_internal_task_events_dropped_total",
+                "Task events shed by the export ring buffer"),
+            "frames": counter(
+                "ray_tpu_internal_proto_frames_total",
+                "Control-plane frames handled"),
+            "trains": counter(
+                "ray_tpu_internal_proto_trains_total",
+                "Socket drains (coalesced frame trains)"),
+            "dispatch_latency": hist(
+                "ray_tpu_internal_dispatch_latency_s",
+                "Queue-ready to dispatch latency",
+                (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)),
+            "train_bytes": hist(
+                "ray_tpu_internal_proto_train_bytes",
+                "Bytes received per socket drain",
+                (256, 4096, 65536, 1 << 20)),
+            "gcs_rpc_latency": hist(
+                "ray_tpu_internal_gcs_rpc_latency_s",
+                "Blocking GCS client RPC round-trip latency",
+                (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 1.0)),
+        }
+        self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
+        if isinstance(self.gcs, GcsClient):
+            self.gcs.rpc_observer = self._observe_gcs_rpc
+
+    def _observe_gcs_rpc(self, op: str, seconds: float):
+        # Called from whichever thread issued the RPC; observe() locks.
+        if self._im is not None:
+            self._im["gcs_rpc_latency"].observe(seconds)
+
+    def _spilled_bytes(self) -> int:
+        store = self._store
+        spill_dir = getattr(store, "_spill_dir", None)
+        if not spill_dir or not os.path.isdir(spill_dir):
+            return 0
+        total = 0
+        try:
+            with os.scandir(spill_dir) as it:
+                for entry in it:
+                    try:
+                        total += entry.stat().st_size
+                    except OSError:
+                        pass
+        except OSError:
+            return 0
+        return total
+
+    def _flush_internal_metrics(self):
+        """Sample event-thread state into the internal metric set and push
+        the payloads under this raylet's own producer key (merged with user
+        metrics by the dashboard's /metrics renderer)."""
+        # Re-arm FIRST (the callback runs under _safe): an exception mid-
+        # flush — e.g. a transient store-attach failure — must not silently
+        # kill the export for the life of the raylet.
+        if not self._shutdown:
+            self.add_timer(config.internal_metrics_interval_s,
+                           self._flush_internal_metrics)
+        im = self._im
+        im["queue_depth"].set(len(self._ready_queue))
+        im["waiting"].set(len(self._waiting))
+        im["worker_pool"].set(sum(
+            1 for c in self._workers.values()
+            if c.actor_id is None and c.state in ("idle", "busy")))
+        im["objects"].set(len(self._objects))
+        store = self._raylet_store()
+        if store is not None and hasattr(store, "stats"):
+            try:
+                im["store_bytes"].set(store.stats()["bytes_in_use"])
+            except Exception:  # noqa: BLE001
+                pass
+            im["spilled_bytes"].set(self._spilled_bytes())
+
+        def bump(counter, key, value, tags=None):
+            delta = value - self._m_last.get(key, 0)
+            if delta > 0:
+                counter.inc(delta, tags=tags)
+            self._m_last[key] = value
+
+        bump(im["frames"], "frames", self._m_frames)
+        bump(im["trains"], "trains", self._m_trains)
+        bump(im["events_dropped"], "dropped", self._task_event_dropped_total)
+        for st, n in self._m_tasks_done.items():
+            bump(im["tasks_total"], f"tasks_{st}", n, tags={"state": st})
+
+        import json as _json
+
+        for m in im.values():
+            payload = m._export()
+            if payload is None:
+                continue
+            self._gcs_post(
+                "kv_put", "metrics",
+                f"{self._im_producer}/{m.name}".encode(),
+                _json.dumps(payload).encode())
+
+    def state_snapshot(self, objects_limit: int = 0) -> dict:
         return {
             "node_id": self.node_id,
             "resources_total": dict(self.resources_total),
@@ -3439,6 +3709,19 @@ class Raylet:
             ],
             "objects": {
                 "num": len(self._objects),
+                # detail rows only on request (``objects_limit`` > 0): the
+                # limit applies HERE, at the source, before materializing —
+                # and reading on the event thread makes the iteration safe.
+                "items": [
+                    {
+                        "object_id": oid.hex(),
+                        "status": st.status,
+                        "size": st.size,
+                        "locations": list(st.locations),
+                    }
+                    for oid, st in itertools.islice(
+                        self._objects.items(), max(0, objects_limit))
+                ] if objects_limit > 0 else None,
             },
             "placement_groups": [
                 {"id": pg.pg_id, "state": pg.state,

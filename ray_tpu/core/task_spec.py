@@ -6,10 +6,22 @@ Covers normal tasks, actor-creation tasks, and actor method calls.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.ids import ActorID, FunctionID, ObjectID, TaskID
+
+
+_JOB_ID = os.environ.get("RAY_TPU_JOB_ID", "driver")
+
+
+def _default_job_id() -> str:
+    """Job attribution for task events: entrypoints launched by the job
+    manager carry their submission id in RAY_TPU_JOB_ID (set by the job
+    supervisor before the driver process starts, so read-once is safe);
+    ad-hoc drivers fall back to one shared bucket."""
+    return _JOB_ID
 
 NORMAL_TASK = "normal"
 ACTOR_CREATION_TASK = "actor_creation"
@@ -66,6 +78,8 @@ class TaskSpec:
     inner_refs: Optional[List[ObjectID]] = None
     # Owner bookkeeping
     submitter: str = "driver"
+    # Job attribution (GCS task-event table is bounded per job)
+    job_id: str = field(default_factory=_default_job_id)
     # Tracing: submit-span context {trace_id, span_id} propagated to the
     # executing worker (reference: span context in task metadata,
     # `tracing_helper.py:289`)

@@ -287,7 +287,8 @@ class Worker:
                 if self.store.contains(oid):
                     try:
                         fast[oid] = (self.read_store_object(
-                            oid, timeout=timeout or 60.0),)
+                            oid,
+                            timeout=60.0 if timeout is None else timeout),)
                         continue
                     except Exception:  # noqa: BLE001 evicted/raced: slow path
                         pass
@@ -344,7 +345,8 @@ class Worker:
             if kind == "inline":
                 out.append(serialization.loads(rest[0]))
             else:  # store
-                out.append(self.read_store_object(oid, timeout=timeout or 60.0))
+                out.append(self.read_store_object(
+                    oid, timeout=60.0 if timeout is None else timeout))
         return out
 
     def read_store_object(self, oid, attempts: int = 3,

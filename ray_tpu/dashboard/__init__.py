@@ -14,7 +14,11 @@ Endpoints:
   GET /api/cluster_resources {total, available} aggregated over alive nodes
   GET /api/load              autoscaler load metrics (demand + idle)
   GET /api/placement_groups  cluster PG table
-  GET /metrics               Prometheus text exposition
+  GET /api/tasks             cluster-wide task table (GCS task events)
+  GET /api/task_summary      state->count + export-drop accounting
+  GET /api/timeline          chrome://tracing trace of the task events
+  GET /metrics               Prometheus text exposition (system gauges +
+                             internal ray_tpu_internal_* + user metrics)
 """
 
 from __future__ import annotations
@@ -86,6 +90,9 @@ class DashboardHead:
             "/api/cluster_resources": self._cluster_resources,
             "/api/load": self._load,
             "/api/placement_groups": self._pgs,
+            "/api/tasks": self._tasks,
+            "/api/task_summary": self._task_summary,
+            "/api/timeline": self._timeline,
         }
         if path in api:
             return json.dumps(api[path](), default=str), "application/json"
@@ -132,6 +139,19 @@ class DashboardHead:
 
     def _pgs(self):
         return self._gcs.state_snapshot().get("placement_groups", [])
+
+    def _tasks(self):
+        """Latest state per task, cluster-wide (GCS task-event table —
+        raylets batch-flush their lifecycle events there)."""
+        return self._gcs.list_task_events()
+
+    def _task_summary(self):
+        return self._gcs.summarize_task_events()
+
+    def _timeline(self):
+        from ray_tpu.util.state import build_timeline
+
+        return build_timeline(self._gcs.task_events_raw())
 
     # ------------------------------------------------------------- metrics
 
@@ -202,7 +222,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px}}</style></head>
 <h2>jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>
 {job_rows}</table>
 <p>APIs: /api/nodes /api/actors /api/jobs /api/cluster_resources /api/load
-/api/placement_groups /metrics</p>
+/api/placement_groups /api/tasks /api/task_summary /api/timeline /metrics</p>
 </body></html>"""
 
     def shutdown(self):

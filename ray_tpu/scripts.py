@@ -109,6 +109,27 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_tasks(args) -> int:
+    """Cluster-wide task table from the GCS task-event export
+    (reference: ``ray list tasks``)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    for row in state.list_tasks(state=args.state, limit=args.limit):
+        print(json.dumps(row, default=str))
+    return 0
+
+
+def cmd_task_summary(args) -> int:
+    """State -> count over every job's tasks, plus export-drop and
+    node-coverage accounting (reference: ``ray summary tasks``)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.task_events_summary(), indent=1, default=str))
+    return 0
+
+
 _CLUSTER_DIR = "/tmp/ray_tpu/clusters"
 
 
@@ -267,6 +288,19 @@ def main(argv=None) -> int:
     p.add_argument("what", choices=["nodes", "actors", "tasks"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("tasks", help="cluster-wide task table (GCS "
+                                     "task-event export)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--state", default=None,
+                   help="filter, e.g. FINISHED / FAILED / RUNNING")
+    p.add_argument("--limit", type=int, default=1000)
+    p.set_defaults(fn=cmd_tasks)
+
+    p = sub.add_parser("task-summary",
+                       help="task state counts + export-drop accounting")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_task_summary)
 
     p = sub.add_parser("up", help="launch a cluster from YAML (ray up)")
     p.add_argument("config")

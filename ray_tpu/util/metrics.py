@@ -22,15 +22,26 @@ import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "flush_metrics",
-           "render_kv_metrics"]
+           "shutdown_metrics", "render_kv_metrics", "internal_metric",
+           "INTERNAL_PREFIX"]
 
 _NS = "metrics"
 _FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_FLUSH_S", "1.0"))
+
+# Metric names under this prefix are reserved for the runtime's own
+# instrumentation (scheduler queue depth, dispatch latency, ...) — user
+# metrics may not claim them (reference: the ray_* internal namespace,
+# `metrics_agent.py:375`).  Internal metrics are built via internal_metric()
+# and flushed by their owner (e.g. the raylet, which has no global worker
+# in cluster mode), not by the per-process flusher thread.
+INTERNAL_PREFIX = "ray_tpu_internal_"
 
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
 _producer_id = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
 _flusher_started = False
+_flusher_stop = threading.Event()
+_mk_internal = threading.local()
 
 
 def _kv_put(key: bytes, value: bytes) -> bool:
@@ -52,10 +63,10 @@ def _ensure_flusher():
         if _flusher_started:
             return
         _flusher_started = True
+        stop = _flusher_stop
 
     def loop():
-        while True:
-            time.sleep(_FLUSH_INTERVAL_S)
+        while not stop.wait(_FLUSH_INTERVAL_S):
             try:
                 flush_metrics()
             except Exception:  # noqa: BLE001
@@ -76,30 +87,85 @@ def flush_metrics():
                 json.dumps(payload).encode())
 
 
+def shutdown_metrics():
+    """End-of-session metrics teardown, called from ``ray_tpu.shutdown()``:
+
+    * final SYNCHRONOUS flush — the daemon flusher would otherwise lose
+      every sample recorded in the last ``RAY_TPU_METRICS_FLUSH_S`` window;
+    * stop the flusher thread and reset ``_flusher_started`` so the next
+      ``init()`` in this process starts a fresh one;
+    * rotate ``_producer_id`` and clear accumulated samples so a re-init
+      against the SAME GCS does not double-report the finished session's
+      counters under two producer keys (counter resets are normal
+      Prometheus semantics).
+    """
+    global _flusher_started, _producer_id, _flusher_stop
+    try:
+        flush_metrics()
+    except Exception:  # noqa: BLE001
+        pass
+    _flusher_stop.set()
+    with _registry_lock:
+        _flusher_started = False
+        _flusher_stop = threading.Event()
+        _producer_id = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        metrics = list(_registry)
+    for m in metrics:
+        with m._lock:
+            m._values.clear()
+
+
+def internal_metric(cls, name: str, *args, **kwargs):
+    """Construct a runtime-internal metric: the reserved
+    ``ray_tpu_internal_`` prefix is allowed (enforced on the name) and the
+    instance is NOT registered with the per-process flusher — the owning
+    component exports it explicitly (see ``Raylet._flush_internal_metrics``,
+    which works even in raylet processes that have no global worker)."""
+    if not name.startswith(INTERNAL_PREFIX):
+        name = INTERNAL_PREFIX + name
+    _mk_internal.on = True
+    try:
+        return cls(name, *args, **kwargs)
+    finally:
+        _mk_internal.on = False
+
+
 class Metric:
     def __init__(self, name: str, description: str = "",
                  tag_keys: Optional[Sequence[str]] = None):
         if not name or any(c in name for c in " \n\t"):
             raise ValueError(f"invalid metric name {name!r}")
+        internal = getattr(_mk_internal, "on", False)
+        if name.startswith(INTERNAL_PREFIX) and not internal:
+            raise ValueError(
+                f"metric name prefix {INTERNAL_PREFIX!r} is reserved for "
+                "runtime-internal metrics")
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
+        self._default_key: Tuple = ()
         self._lock = threading.Lock()
-        with _registry_lock:
-            _registry.append(self)
-        _ensure_flusher()
+        if not internal:
+            with _registry_lock:
+                _registry.append(self)
+            _ensure_flusher()
 
     def set_default_tags(self, tags: Dict[str, str]):
         unknown = set(tags) - set(self.tag_keys)
         if unknown:
             raise ValueError(f"tags {unknown} not in tag_keys")
         self._default_tags = dict(tags)
+        self._default_key = tuple(sorted(self._default_tags.items()))
         return self
 
     def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        if tags is None:
+            # Hot path (per-observation internal metrics): the default-tag
+            # key is precomputed — no dict copy/sort per sample.
+            return self._default_key
         merged = dict(self._default_tags)
-        merged.update(tags or {})
+        merged.update(tags)
         unknown = set(merged) - set(self.tag_keys)
         if unknown:
             raise ValueError(f"tags {unknown} not in tag_keys "
@@ -257,12 +323,13 @@ def render_kv_metrics(gcs) -> List[str]:
                 cum = 0
                 for i, b in enumerate(bounds):
                     cum += val[i]
+                    le = 'le="%s"' % b
                     lines.append(
-                        f"{name}_bucket"
-                        f"{labels(tag_key, [f'le=\"{b}\"'])} {cum}")
+                        f"{name}_bucket{labels(tag_key, [le])} {cum}")
                 cum += val[len(bounds)]
+                inf = 'le="+Inf"'
                 lines.append(
-                    f"{name}_bucket{labels(tag_key, ['le=\"+Inf\"'])} {cum}")
+                    f"{name}_bucket{labels(tag_key, [inf])} {cum}")
                 lines.append(f"{name}_sum{labels(tag_key)} {val[-2]}")
                 lines.append(f"{name}_count{labels(tag_key)} {val[-1]}")
     return lines

@@ -2,34 +2,53 @@
 
 Reference analogue: `python/ray/util/state/api.py` (``list_actors`` `:782`,
 ``list_nodes`` `:874`, ``list_tasks`` `:1009`, ``list_objects`` `:1054`,
-``summarize_tasks`` `:1367`) over the dashboard's StateAggregator.  Here the
-sources are the GCS tables (nodes/actors — cluster-wide) and the connected
-raylet's snapshot (tasks/objects — node-local views; cluster-wide task
-aggregation lands with GCS task-event export).
+``summarize_tasks`` `:1367`) over the dashboard's StateAggregator.  Sources:
+the GCS tables — nodes/actors AND, since the task-event export landed, the
+cluster-wide task-event table (every raylet batch-flushes its task
+lifecycle events there) — plus the connected raylet's snapshot for
+node-local object detail.
 """
 
 from __future__ import annotations
 
-from collections import Counter
+import time
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.core.worker import global_worker
+
+def _worker():
+    from ray_tpu.core.worker import global_worker
+
+    return global_worker()
 
 
-def _snapshot() -> dict:
-    w = global_worker()
+def _snapshot(objects_limit: int = 0) -> dict:
+    w = _worker()
     if w.mode == "driver":
-        return w.raylet.call(w.raylet.state_snapshot).result()
+        return w.raylet.call(w.raylet.state_snapshot, objects_limit).result()
     if w.mode == "local":
         return {"node_id": "local", "tasks": [], "actors": [],
-                "objects": {"num": 0}, "events": [],
+                "objects": {"num": 0, "items": []}, "events": [],
                 "resources_total": {}, "resources_available": {}}
-    return w._request("state_snapshot")
+    return w._request("state_snapshot", objects_limit=objects_limit)
+
+
+def _task_table_call(op: str, **kw):
+    """Query the GCS task-event table cluster-wide.  The connected raylet's
+    export buffer is flushed first so just-finished local tasks are visible;
+    remote raylets flush on their own cadence (poll for their tail)."""
+    w = _worker()
+    if w.mode == "local":
+        return None
+    if w.mode == "driver":
+        w.raylet.call(w.raylet.flush_task_events).result()
+        return getattr(w.raylet.gcs, op)(**kw)
+    # worker / client modes: the raylet flushes locally and proxies the op
+    return w._request(op, **kw)
 
 
 def list_nodes() -> List[Dict[str, Any]]:
     """Cluster membership with resources (GCS node table)."""
-    w = global_worker()
+    w = _worker()
     return [
         {
             "node_id": n["node_id"],
@@ -46,7 +65,7 @@ def list_nodes() -> List[Dict[str, Any]]:
 def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
     """Cluster-wide actor table (GCS) merged with the local raylet's
     richer per-actor detail when available."""
-    w = global_worker()
+    w = _worker()
     local = {a["actor_id"]: a for a in _snapshot().get("actors", [])}
     if w.mode == "driver":
         gcs_actors = w.raylet.gcs.list_actors()
@@ -79,44 +98,150 @@ def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
 
 def list_tasks(state: Optional[str] = None,
                limit: int = 1000) -> List[Dict[str, Any]]:
-    """Task table from the connected raylet's event log (latest state per
-    task)."""
-    tasks = list(_snapshot().get("tasks", []))
-    if state is not None:
-        tasks = [t for t in tasks if t["state"] == state.upper()]
-    return tasks[:limit]
+    """Cluster-wide task table: latest known state per task from the GCS
+    task-event table (reference: ``list_tasks``, `api.py:1009`), including
+    tasks executed on OTHER nodes."""
+    rows = _task_table_call("list_task_events", state=state, limit=limit)
+    return list(rows or [])
 
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
-    """Object metadata known to the connected raylet."""
-    w = global_worker()
-    if w.mode != "driver":
-        snap = _snapshot()
-        return [{"count": snap.get("objects", {}).get("num", 0)}]
-
-    def collect():
-        return [
-            {
-                "object_id": oid.hex(),
-                "status": st.status,
-                "size": st.size,
-                "locations": list(st.locations),
-            }
-            for oid, st in list(w.raylet._objects.items())[:limit]
-        ]
-
-    return w.raylet.call(collect).result()
+    """Object metadata known to the connected raylet.  Routed through the
+    raylet-thread ``state_snapshot`` (never reads ``_objects`` off-thread)
+    with ``limit`` applied at the source, before materializing."""
+    snap = _snapshot(objects_limit=max(1, limit))
+    return list(snap.get("objects", {}).get("items") or [])
 
 
 def summarize_tasks() -> Dict[str, int]:
-    """State -> count (reference: ``summarize_tasks``, `api.py:1367`)."""
-    return dict(Counter(t["state"] for t in _snapshot().get("tasks", [])))
+    """State -> count, cluster-wide (reference: ``summarize_tasks``,
+    `api.py:1367`)."""
+    summary = _task_table_call("summarize_task_events")
+    return dict((summary or {}).get("by_state", {}))
+
+
+def task_events_summary() -> Dict[str, Any]:
+    """Full task-event accounting: state counts, distinct reporting nodes,
+    and the cluster-wide export drop counter (ring-buffer backpressure)."""
+    return dict(_task_table_call("summarize_task_events") or {})
 
 
 def summarize_objects() -> Dict[str, Any]:
     objs = list_objects(limit=100000)
-    if objs and "status" in objs[0]:
-        by_status = Counter(o["status"] for o in objs)
-        return {"total": len(objs), "by_status": dict(by_status),
-                "bytes_known": sum(o.get("size", 0) for o in objs)}
-    return {"total": objs[0]["count"] if objs else 0}
+    by_status: Dict[str, int] = {}
+    for o in objs:
+        by_status[o["status"]] = by_status.get(o["status"], 0) + 1
+    return {"total": len(objs), "by_status": by_status,
+            "bytes_known": sum(o.get("size", 0) for o in objs)}
+
+
+# --------------------------------------------------------------- timeline
+
+
+def build_timeline(events: List[dict], spans: Optional[List[dict]] = None,
+                   now: Optional[float] = None) -> List[dict]:
+    """chrome://tracing trace from raw task events (and, when tracing is
+    on, driver-side submit spans).
+
+    Per task attempt, TWO sub-slices make queue wait visible next to run
+    time: ``queue_wait`` (QUEUED/PENDING_ARGS -> dispatch) and ``run``
+    (dispatch -> terminal).  Still-in-flight tasks get an OPEN-ENDED slice
+    ending at ``now`` instead of being silently dropped, and tasks that
+    fail before dispatch close their queue slice at the failure — nothing
+    leaks (reference: ``ray.timeline``, `python/ray/_private/state.py:416`).
+    Submit spans become flow arrows (``s``/``f``) from the submitting
+    process to the first run slice of the task.
+    """
+    now = time.time() if now is None else now
+    per_task: Dict[str, List[dict]] = {}
+    for ev in sorted(events, key=lambda e: e.get("time", 0.0)):
+        per_task.setdefault(ev["task_id"], []).append(ev)
+
+    trace: List[dict] = []
+    first_run: Dict[str, dict] = {}  # task_id -> first run slice (flow tgt)
+
+    def emit(name, phase, t0, t1, pid, tid_hex, **args):
+        sl = {
+            "cat": "task", "name": name, "ph": "X",
+            "ts": t0 * 1e6, "dur": max(0.0, (t1 - t0)) * 1e6,
+            "pid": pid, "tid": pid,
+            "args": {"phase": phase, "task_id": tid_hex, **args},
+        }
+        trace.append(sl)
+        return sl
+
+    for tid, evs in per_task.items():
+        name = next((e.get("name") for e in evs if e.get("name")), tid[:8])
+        queued_t: Optional[float] = None
+        run_t: Optional[float] = None
+        pid = 0
+        node = evs[-1].get("node_id", "")
+        for ev in evs:
+            st = ev.get("state")
+            t = ev.get("time", 0.0)
+            if st in ("PENDING_ARGS", "QUEUED", "PENDING"):
+                if queued_t is None:
+                    queued_t = t
+            elif st in ("RUNNING", "DISPATCHED"):
+                if run_t is None:
+                    run_t = t
+                    pid = ev.get("pid") or 0
+                    if queued_t is not None:
+                        emit(name, "queue_wait", queued_t, t, pid, tid,
+                             node_id=ev.get("node_id", node))
+                        queued_t = None
+            elif st in ("FINISHED", "FAILED", "OOM_KILLED"):
+                start = run_t if run_t is not None else t
+                sl = emit(name, "run", start, t, pid, tid, state=st,
+                          node_id=ev.get("node_id", node),
+                          **({"error": ev["error"]} if ev.get("error")
+                             else {}))
+                first_run.setdefault(tid, sl)
+                run_t = queued_t = None
+            elif st in ("RETRYING", "REQUEUED", "SPILLED", "FORWARDED",
+                        "RECONSTRUCTING"):
+                # attempt boundary: close whatever phase was open here
+                if run_t is not None:
+                    sl = emit(name, "run", run_t, t, pid, tid, state=st,
+                              node_id=ev.get("node_id", node))
+                    first_run.setdefault(tid, sl)
+                elif queued_t is not None:
+                    emit(name, "queue_wait", queued_t, t, pid, tid, state=st,
+                         node_id=ev.get("node_id", node))
+                run_t = queued_t = None
+        # in-flight work: open-ended slices up to `now` (never dropped)
+        if run_t is not None:
+            sl = emit(name, "run", run_t, now, pid, tid, state="RUNNING",
+                      in_flight=True, node_id=node)
+            first_run.setdefault(tid, sl)
+        elif queued_t is not None:
+            emit(name, "queue_wait", queued_t, now, pid, tid,
+                 in_flight=True, node_id=node)
+
+    # flow arrows from submit spans (tracing on): submitting process ->
+    # the task's first run slice
+    for sp in spans or []:
+        tid = (sp.get("attributes") or {}).get("task_id")
+        if not tid or not str(sp.get("name", "")).startswith("task.submit"):
+            continue
+        t0 = sp.get("start_us", 0) / 1e6
+        t1 = t0 + sp.get("duration_us", 0) / 1e6
+        spid = sp.get("pid", 0)
+        trace.append({"cat": "submit", "name": sp["name"], "ph": "X",
+                      "ts": t0 * 1e6,
+                      "dur": sp.get("duration_us", 0), "pid": spid,
+                      "tid": spid, "args": {"task_id": tid}})
+        target = first_run.get(tid)
+        if target is None:
+            continue
+        trace.append({"cat": "flow", "name": "submit", "ph": "s",
+                      "id": tid, "ts": t1 * 1e6, "pid": spid, "tid": spid})
+        trace.append({"cat": "flow", "name": "submit", "ph": "f",
+                      "bp": "e", "id": tid, "ts": target["ts"],
+                      "pid": target["pid"], "tid": target["tid"]})
+    return trace
+
+
+def raw_task_events(limit: int = 100000) -> List[dict]:
+    """The cluster-wide raw event log (every recorded transition)."""
+    return list(_task_table_call("task_events_raw", limit=limit) or [])

@@ -142,8 +142,12 @@ def submit_with_span(worker, spec, **attrs):
         return worker.submit_spec(spec)
 
 
-def read_spans(trace_dir: Optional[str] = None):
-    """All spans recorded under the trace dir (tests/tooling)."""
+def read_spans(trace_dir: Optional[str] = None,
+               name_prefix: Optional[str] = None):
+    """All spans recorded under the trace dir (tests/tooling).
+    ``name_prefix`` filters at read time (e.g. ``"task.submit"`` — the
+    timeline's flow-event feed) so callers don't materialize every
+    execution span of a long run just to pick out the submits."""
     trace_dir = trace_dir or _trace_dir or os.environ.get(_ENV)
     out = []
     if not trace_dir or not os.path.isdir(trace_dir):
@@ -154,7 +158,11 @@ def read_spans(trace_dir: Optional[str] = None):
         with open(os.path.join(trace_dir, name)) as f:
             for line in f:
                 try:
-                    out.append(json.loads(line))
+                    span_rec = json.loads(line)
                 except ValueError:
-                    pass
+                    continue
+                if (name_prefix is None
+                        or str(span_rec.get("name", ""))
+                        .startswith(name_prefix)):
+                    out.append(span_rec)
     return out

@@ -1,0 +1,362 @@
+"""Cluster-wide task-event export + internal runtime metrics.
+
+Reference behaviors: the GCS task-event backend behind ``list_tasks`` /
+``summarize_tasks`` / ``ray.timeline()`` (`python/ray/util/state/api.py:1009`)
+and the per-node metrics agent's internal ``ray_*`` series
+(`python/ray/_private/metrics_agent.py:375`).
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.config import config
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"remote_res": 4})
+    c.wait_for_nodes(2)
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dashboard(two_node_cluster):
+    from ray_tpu.dashboard import DashboardHead
+
+    d = DashboardHead(two_node_cluster.address)
+    yield d
+    d.shutdown()
+
+
+def _http(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def _run_on_both_nodes(n: int = 4):
+    @ray_tpu.remote
+    def local_task():
+        return "ok"
+
+    @ray_tpu.remote(resources={"remote_res": 0.01})
+    def remote_task():
+        return "ok"
+
+    ray_tpu.get([local_task.remote() for _ in range(n)]
+                + [remote_task.remote() for _ in range(n)])
+    return 2 * n
+
+
+def test_cluster_wide_task_events(two_node_cluster):
+    """summarize_tasks()/list_tasks() on the driver see FINISHED tasks
+    executed on BOTH nodes — the remote raylet's events reach the GCS
+    task-event table (remote flushes land on their own cadence: poll)."""
+    total = _run_on_both_nodes()
+    deadline = time.monotonic() + 60  # slow hosts: worker spawn + flush lag
+    summary = {}
+    while time.monotonic() < deadline:
+        summary = state.task_events_summary()
+        if (summary.get("by_state", {}).get("FINISHED", 0) >= total
+                and len(summary.get("nodes", [])) >= 2):
+            break
+        time.sleep(0.25)
+    assert summary["by_state"]["FINISHED"] >= total, summary
+    assert len(summary["nodes"]) >= 2, summary
+    assert state.summarize_tasks().get("FINISHED", 0) >= total
+
+    finished = state.list_tasks(state="FINISHED")
+    exec_nodes = {t["node_id"] for t in finished}
+    assert len(exec_nodes) >= 2, finished
+    names = {t["name"] for t in finished}
+    assert {"local_task", "remote_task"} <= names, names
+    # per-event metadata the export pipeline carries
+    row = finished[0]
+    assert "job_id" in row and "attempt" in row and "time" in row
+
+
+def test_dashboard_tasks_and_timeline_roundtrip(two_node_cluster, dashboard):
+    _run_on_both_nodes(2)
+    deadline = time.monotonic() + 20
+    rows = []
+    while time.monotonic() < deadline:
+        rows = json.loads(_http(dashboard.url + "/api/tasks"))
+        if any(t["state"] == "FINISHED" for t in rows):
+            break
+        time.sleep(0.25)
+    assert any(t["state"] == "FINISHED" for t in rows), rows
+    summary = json.loads(_http(dashboard.url + "/api/task_summary"))
+    assert summary["by_state"].get("FINISHED", 0) >= 1
+    assert "num_dropped" in summary
+    trace = json.loads(_http(dashboard.url + "/api/timeline"))
+    phases = {s.get("args", {}).get("phase") for s in trace}
+    assert "run" in phases and "queue_wait" in phases, phases
+
+
+def test_internal_metrics_exported(two_node_cluster, dashboard):
+    """/metrics grows >= 5 distinct ray_tpu_internal_* series once the
+    raylets' internal flushers have run."""
+    _run_on_both_nodes(2)
+    deadline = time.monotonic() + 20
+    base = set()
+    while time.monotonic() < deadline:
+        text = _http(dashboard.url + "/metrics")
+        series = {ln.split("{")[0].split(" ")[0]
+                  for ln in text.splitlines()
+                  if ln.startswith("ray_tpu_internal_")}
+        base = {s.removesuffix("_bucket").removesuffix("_sum")
+                 .removesuffix("_count") for s in series}
+        if len(base) >= 5:
+            break
+        time.sleep(0.5)
+    assert len(base) >= 5, base
+    assert "ray_tpu_internal_scheduler_queue_depth" in base
+    assert "ray_tpu_internal_worker_pool_size" in base
+
+
+def test_tasks_cli_subcommands(two_node_cluster):
+    _run_on_both_nodes(1)
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "task-summary",
+         "--address", two_node_cluster.address],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-400:]
+    summary = json.loads(out.stdout)
+    assert summary["by_state"].get("FINISHED", 0) >= 1, summary
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "tasks",
+         "--address", two_node_cluster.address, "--state", "FINISHED",
+         "--limit", "5"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-400:]
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert rows and all(r["state"] == "FINISHED" for r in rows)
+    assert len(rows) <= 5
+
+
+def test_timeline_api_includes_running_tasks(two_node_cluster):
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(8)
+
+    ref = sleeper.remote()
+    deadline = time.monotonic() + 15
+    found = False
+    while time.monotonic() < deadline and not found:
+        trace = ray_tpu.timeline()
+        found = any(s.get("args", {}).get("in_flight")
+                    and s["name"] == "sleeper" for s in trace)
+        if not found:
+            time.sleep(0.3)
+    assert found, "still-running task missing from timeline"
+    ray_tpu.get(ref)
+
+
+def test_drop_counter_on_buffer_overflow():
+    """The export ring buffer sheds oldest events (never blocks dispatch)
+    and the drop counter ships with the next flush."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    old = (config.task_event_export_buffer, config.task_event_batch_max,
+           config.task_event_flush_interval_s)
+    config.task_event_export_buffer = 4
+    config.task_event_batch_max = 1 << 30   # no size-triggered flush
+    config.task_event_flush_interval_s = 60.0  # no timer flush in-window
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def quick():
+            return 1
+
+        ray_tpu.get([quick.remote() for _ in range(20)])
+        summary = state.task_events_summary()  # forces a flush
+        assert summary["num_dropped"] > 0, summary
+        # the ring kept the NEWEST events: the latest states still arrived
+        assert summary["by_state"], summary
+    finally:
+        (config.task_event_export_buffer, config.task_event_batch_max,
+         config.task_event_flush_interval_s) = old
+        ray_tpu.shutdown()
+
+
+def test_state_api_inside_worker():
+    """Workers query the cluster-wide task table through their raylet
+    (list_task_events proxy op)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def outer():
+            from ray_tpu.util import state as wstate
+
+            return wstate.summarize_tasks()
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(3)])
+        summary = ray_tpu.get(outer.remote())
+        assert summary.get("FINISHED", 0) >= 1, summary
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_task_events_disabled_via_config():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        config.task_events = False
+
+        @ray_tpu.remote
+        def quick():
+            return 1
+
+        ray_tpu.get([quick.remote() for _ in range(3)])
+        assert state.summarize_tasks() == {}
+        config.task_events = True
+        ray_tpu.get([quick.remote() for _ in range(3)])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if state.summarize_tasks().get("FINISHED", 0) >= 3:
+                break
+            time.sleep(0.1)
+        assert state.summarize_tasks().get("FINISHED", 0) >= 3
+    finally:
+        config.task_events = True
+        ray_tpu.shutdown()
+
+
+def test_gcs_table_per_job_caps_and_filters():
+    """GCS-side unit: per-job bounded store (newest kept), job isolation,
+    drop accounting, state filter + source-side limit."""
+    from ray_tpu.core.gcs import GcsCore
+
+    old_cap = config.task_events_max_per_job
+    config.task_events_max_per_job = 5
+    try:
+        g = GcsCore()
+        evs = [{"task_id": f"t{i}", "state": "FINISHED", "time": float(i),
+                "job_id": "jobA", "node_id": "n1"} for i in range(12)]
+        g.add_task_events("n1", evs, dropped=3)
+        g.add_task_events("n2", [{"task_id": "x1", "state": "RUNNING",
+                                  "time": 99.0, "job_id": "jobB",
+                                  "node_id": "n2"}])
+        a = g.list_task_events(job_id="jobA", limit=100)
+        assert len(a) == 5  # per-job cap, oldest evicted
+        assert {e["task_id"] for e in a} == {f"t{i}" for i in range(7, 12)}
+        assert len(g.task_events_raw(job_id="jobA")) == 5
+        s = g.summarize_task_events()
+        assert s["num_dropped"] == 3 and s["num_tasks"] == 6, s
+        assert s["nodes"] == ["n1", "n2"], s
+        assert len(g.list_task_events(job_id="jobB")) == 1
+        f = g.list_task_events(state="finished", limit=2)
+        assert len(f) == 2 and all(e["state"] == "FINISHED" for e in f)
+    finally:
+        config.task_events_max_per_job = old_cap
+
+
+# --------------------------------------------------------------- timeline
+
+
+def test_build_timeline_open_ended_and_orphans():
+    """Satellite regressions: still-RUNNING tasks must appear (open-ended
+    slice up to `now`), and a task failing BEFORE it runs closes its queue
+    slice instead of leaking a dangling start."""
+    from ray_tpu.util.state import build_timeline
+
+    t0 = 1000.0
+    events = [
+        # task A: queued -> running, never finishes (in flight)
+        {"task_id": "aa", "name": "inflight", "state": "QUEUED",
+         "time": t0, "node_id": "n1"},
+        {"task_id": "aa", "name": "inflight", "state": "RUNNING",
+         "time": t0 + 1, "node_id": "n1", "pid": 7},
+        # task B: fails before ever dispatching (dep error)
+        {"task_id": "bb", "name": "orphan", "state": "PENDING_ARGS",
+         "time": t0, "node_id": "n1"},
+        {"task_id": "bb", "name": "orphan", "state": "FAILED",
+         "time": t0 + 2, "node_id": "n1", "error": "ValueError: dep"},
+        # task C: full lifecycle
+        {"task_id": "cc", "name": "full", "state": "QUEUED",
+         "time": t0, "node_id": "n1"},
+        {"task_id": "cc", "name": "full", "state": "RUNNING",
+         "time": t0 + 0.5, "node_id": "n1", "pid": 8},
+        {"task_id": "cc", "name": "full", "state": "FINISHED",
+         "time": t0 + 3, "node_id": "n1"},
+    ]
+    trace = build_timeline(events, now=t0 + 10)
+    by_name = {}
+    for sl in trace:
+        by_name.setdefault(sl["name"], []).append(sl)
+
+    inflight = [s for s in by_name["inflight"]
+                if s["args"]["phase"] == "run"]
+    assert len(inflight) == 1
+    assert inflight[0]["args"].get("in_flight") is True
+    assert inflight[0]["dur"] == pytest.approx(9 * 1e6)  # t0+1 .. now
+
+    orphan = by_name["orphan"]
+    assert len(orphan) == 1  # queue slice closed at the failure, no leak
+    assert orphan[0]["args"]["phase"] == "run" or \
+        orphan[0]["args"].get("state") == "FAILED"
+
+    full = {s["args"]["phase"]: s for s in by_name["full"]}
+    assert full["queue_wait"]["dur"] == pytest.approx(0.5 * 1e6)
+    assert full["run"]["dur"] == pytest.approx(2.5 * 1e6)
+    assert full["run"]["args"]["state"] == "FINISHED"
+
+
+# --------------------------------------------------------------- metrics
+
+
+def test_metrics_reserved_prefix_rejected():
+    from ray_tpu.util.metrics import Counter, Gauge, internal_metric
+
+    with pytest.raises(ValueError):
+        Counter("ray_tpu_internal_bogus")
+    m = internal_metric(Gauge, "ray_tpu_internal_ok", "fine",
+                        tag_keys=("node",))
+    assert m.name == "ray_tpu_internal_ok"
+
+
+def test_metrics_shutdown_flushes_and_resets():
+    """Satellite: shutdown() performs a final synchronous flush (the last
+    window's samples are NOT lost) and resets the flusher/producer so a
+    re-init in the same process doesn't double-report."""
+    from ray_tpu.util import metrics as m
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    w = ray_tpu.global_worker()
+    gcs = w.raylet.gcs
+    c = m.Counter("test_shutdown_flush_total")
+    c.inc(5)
+    producer_before = m._producer_id
+    ray_tpu.shutdown()  # must flush synchronously before teardown
+    key = f"{producer_before}/test_shutdown_flush_total".encode()
+    raw = gcs.kv_get("metrics", key)
+    assert raw is not None, "final flush lost the last window's samples"
+    assert json.loads(raw)["samples"][0][1] == 5
+    # reset for the next init cycle: fresh producer id, no stale samples,
+    # flusher restartable
+    assert m._producer_id != producer_before
+    assert m._flusher_started is False
+    assert c._export() is None
