@@ -117,6 +117,15 @@ config.define("health_check_period_s", float, 1.0, "")
 config.define("task_event_buffer_size", int, 10000,
               "Max buffered task state events for the state API.")
 
+# --- data plane --------------------------------------------------------------
+config.define("data_channel", bool, True,
+              "Zero-copy raylet-to-raylet data plane: bulk object bytes "
+              "move on a dedicated per-peer TCP connection with a raw "
+              "binary protocol (data_channel.py) driven by the pull "
+              "manager (pull_manager.py).  RAY_TPU_DATA_CHANNEL=0 falls "
+              "back to single-source pickled chunks on the control "
+              "socket (the pre-data-plane path, kept for parity tests).")
+
 # --- observability -----------------------------------------------------------
 config.define("task_events", bool, True,
               "Export task lifecycle events to the GCS task-event table "

@@ -220,7 +220,8 @@ class GcsCore:
                       resources: Dict[str, float],
                       store_path: Optional[str] = None,
                       hostname: str = "",
-                      labels: Optional[Dict[str, str]] = None) -> List[dict]:
+                      labels: Optional[Dict[str, str]] = None,
+                      data_port: Optional[int] = None) -> List[dict]:
         """``labels`` carry scheduler-visible topology metadata (SURVEY §7
         items 3-4): ``accelerator_type`` (e.g. "v5e-8"), ``tpu_slice``
         (the pod-slice id — nodes sharing it are ICI-adjacent),
@@ -231,6 +232,9 @@ class GcsCore:
             self._nodes[node_id] = {
                 "node_id": node_id,
                 "address": address,
+                # data-plane listener (zero-copy object transfer); None for
+                # nodes running without a data channel
+                "data_port": data_port,
                 "resources_total": dict(resources),
                 "resources_available": dict(resources),
                 "store_path": store_path,
@@ -462,14 +466,25 @@ class GcsCore:
     # ----------------------------------------------------------- placement
 
     def place_task(self, resources: Dict[str, float],
-                   exclude: Optional[List[str]] = None) -> Optional[str]:
-        """Pick an alive node whose AVAILABLE resources fit — most-available
-        first (a spread-flavoured policy; the reference's hybrid policy packs
-        to 50% then spreads, `scheduling/policy/hybrid_scheduling_policy.h:50`).
+                   exclude: Optional[List[str]] = None,
+                   arg_ids: Optional[List[str]] = None) -> Optional[str]:
+        """Pick an alive node whose AVAILABLE resources fit — most
+        argument bytes already local first (``arg_ids``: the task's
+        dependency object ids, scored against the object directory —
+        reference: locality-aware leasing), then most-available (a
+        spread-flavoured policy; the reference's hybrid policy packs to
+        50% then spreads, `scheduling/policy/hybrid_scheduling_policy.h:50`).
         Returns None when nothing fits right now."""
         exclude = set(exclude or ())
         best, best_score = None, None
         with self._lock:
+            loc_bytes: Dict[str, int] = {}
+            for oid in arg_ids or ():
+                entry = self._objects.get(oid)
+                if entry:
+                    for nid in entry["nodes"]:
+                        loc_bytes[nid] = loc_bytes.get(nid, 0) \
+                            + (entry["size"] or 0)
             for nid, info in self._nodes.items():
                 if not info["alive"] or nid in exclude \
                         or info.get("draining"):
@@ -477,7 +492,8 @@ class GcsCore:
                 avail = info["resources_available"]
                 if all(avail.get(k, 0.0) + 1e-9 >= v
                        for k, v in resources.items()):
-                    score = sum(avail.values()) - len(resources)
+                    score = (loc_bytes.get(nid, 0),
+                             sum(avail.values()) - len(resources))
                     if best_score is None or score > best_score:
                         best, best_score = nid, score
         return best
@@ -781,9 +797,13 @@ class GcsCore:
                 oid, {"nodes": set(), "size": size, "inline": inline})
             entry["nodes"].add(node_id)
             entry["size"] = max(entry["size"], size)
+            entry["inline"] = entry["inline"] or inline
+            push_size, push_inline = entry["size"], entry["inline"]
             watchers = self._object_watchers.pop(oid, set())
         for w in watchers:
-            self._publish("object_at", {"oid": oid, "node_id": node_id},
+            self._publish("object_at",
+                          {"oid": oid, "node_id": node_id,
+                           "size": push_size, "inline": push_inline},
                           target_node=w)
 
     def remove_object_location(self, oid: str, node_id: Optional[str] = None):

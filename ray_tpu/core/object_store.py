@@ -17,8 +17,10 @@ mapping itself stays valid, so this can never segfault).
 from __future__ import annotations
 
 import ctypes
+import itertools
 import mmap as _mmap
 import os
+import threading
 import time
 import weakref
 from typing import Any, Optional
@@ -38,6 +40,11 @@ config.define("object_store_spill", bool, True,
 
 class ObjectStoreFullError(RuntimeError):
     pass
+
+
+# Disambiguates concurrent spill tmp files within one process (itertools
+# .count() is GIL-atomic).
+_spill_tmp_seq = itertools.count()
 
 
 class ObjectLostError(_BaseObjectLostError):
@@ -155,6 +162,10 @@ class ShmObjectStore:
     def __init__(self, path: str, spill_dir: Optional[str] = None):
         self._path = path
         self._lib = _get_lib()
+        # Serializes close() against native calls from data-plane threads
+        # (serve/receive): a check-then-act on _handle alone could pass a
+        # NULL/freed handle into C during raylet shutdown.
+        self._close_lock = threading.Lock()
         self._handle = self._lib.rt_store_attach(path.encode())
         if not self._handle:
             raise OSError(f"cannot attach to object store at {path}")
@@ -180,7 +191,11 @@ class ShmObjectStore:
 
     def spill_raw(self, object_id: ObjectID, data):
         os.makedirs(self._spill_dir, exist_ok=True)
-        tmp = self._spill_path(object_id) + f".tmp{os.getpid()}"
+        # Per-process counter in the tmp name: a pid-only suffix collides
+        # when two THREADS of one process spill the same object
+        # concurrently (one writer truncates the file under the other).
+        tmp = (self._spill_path(object_id)
+               + f".tmp{os.getpid()}.{next(_spill_tmp_seq)}")
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, self._spill_path(object_id))
@@ -221,9 +236,12 @@ class ShmObjectStore:
     def create(self, object_id: ObjectID, size: int,
                allow_evict: bool = True) -> memoryview:
         off = ctypes.c_uint64()
-        rc = self._lib.rt_create_opts(self._handle, object_id.binary(),
-                                      size, ctypes.byref(off),
-                                      1 if allow_evict else 0)
+        with self._close_lock:
+            if not self._handle:
+                raise ObjectStoreFullError("store is closed")
+            rc = self._lib.rt_create_opts(self._handle, object_id.binary(),
+                                          size, ctypes.byref(off),
+                                          1 if allow_evict else 0)
         if rc == -17:  # EEXIST
             raise FileExistsError(object_id.hex())
         if rc != 0:
@@ -233,26 +251,39 @@ class ShmObjectStore:
         return self._view[off.value : off.value + size]
 
     def seal(self, object_id: ObjectID):
-        self._lib.rt_seal(self._handle, object_id.binary())
+        with self._close_lock:
+            if self._handle:
+                self._lib.rt_seal(self._handle, object_id.binary())
 
     def release(self, object_id: ObjectID):
-        self._lib.rt_release(self._handle, object_id.binary())
+        with self._close_lock:
+            if self._handle:
+                self._lib.rt_release(self._handle, object_id.binary())
 
     def abort(self, object_id: ObjectID):
-        self._lib.rt_abort(self._handle, object_id.binary())
+        with self._close_lock:
+            if self._handle:
+                self._lib.rt_abort(self._handle, object_id.binary())
 
     def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
         """Pin + return buffer view, or None if absent/unsealed."""
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
-        rc = self._lib.rt_get(self._handle, object_id.binary(),
-                              ctypes.byref(off), ctypes.byref(size))
-        if rc != 0:
-            return None
-        return self._view[off.value : off.value + size.value]
+        with self._close_lock:
+            if not self._handle:  # closed (raylet shutdown) — data-plane
+                return None       # serve threads may race one request in
+            rc = self._lib.rt_get(self._handle, object_id.binary(),
+                                  ctypes.byref(off), ctypes.byref(size))
+            if rc != 0:
+                return None
+            return self._view[off.value : off.value + size.value]
 
     def contains(self, object_id: ObjectID) -> bool:
-        return bool(self._lib.rt_contains(self._handle, object_id.binary()))
+        with self._close_lock:
+            if not self._handle:
+                return False
+            return bool(self._lib.rt_contains(self._handle,
+                                              object_id.binary()))
 
     def delete(self, object_id: ObjectID) -> bool:
         ok = self._lib.rt_delete(self._handle, object_id.binary()) == 0
@@ -347,11 +378,12 @@ class ShmObjectStore:
             delay = min(delay * 2, 0.01)
 
     def close(self):
-        if self._handle:
-            self._view.release()
-            self._mmap.close()
-            self._lib.rt_store_detach(self._handle)
-            self._handle = None
+        with self._close_lock:
+            if self._handle:
+                self._view.release()
+                self._mmap.close()
+                self._lib.rt_store_detach(self._handle)
+                self._handle = None
 
 
 class InProcObjectStore:

@@ -7,6 +7,12 @@ raylet connection (`src/ray/raylet/format/node_manager.fbs`).  Single-node
 round 1 uses one unix stream socket per worker; the multi-node transport
 (gRPC across hosts) slots in behind the same message schema.
 
+BULK DATA does not ride this protocol: raylet-to-raylet object bytes move
+on a dedicated per-peer-pair TCP connection with a raw binary header
+format (see ``data_channel.py``) so control frames never queue behind
+megabytes of payload.  Only the python-fallback pull path (and inline
+objects) still ship object bytes as pickled control frames.
+
 Message = arbitrary picklable dict with a "t" (type) key.  Types:
 
 driver->worker:
@@ -287,6 +293,19 @@ def recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
             return None
         got += r
     return out
+
+
+def recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
+    """Fill ``view`` completely via recv_into; False on EOF.  Shared by the
+    control-plane readers and the zero-copy data channel (which recv_intos
+    straight into shm store buffers — see data_channel.py)."""
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            return False
+        got += r
+    return True
 
 
 def recv_msg(sock: socket.socket) -> Optional[Any]:

@@ -29,6 +29,8 @@ from __future__ import annotations
 import heapq
 import itertools
 import os
+import queue as _queue
+import random
 import selectors
 import socket
 import subprocess
@@ -85,6 +87,18 @@ config.define("ref_free_grace_s", float, 2.0,
 config.define("max_lineage_entries", int, 20000,
               "Max objects whose creating TaskSpec is retained for "
               "eviction recovery (reference: lineage byte caps).")
+config.define("pull_sender_threads", int, 2,
+              "Bounded sender pool for the python-fallback pull path "
+              "(control-plane chunk streams).  A burst of pulls queues "
+              "behind these threads instead of spawning one thread per "
+              "request; saturation is counted in "
+              "ray_tpu_internal_pull_sender_saturated_total.")
+config.define("locality_aware_min_bytes", int, 1 << 20,
+              "Locality-aware placement (reference: locality_aware lease "
+              "policy): a task whose remote arguments hold at least this "
+              "many bytes on some peer — and more than are local here — "
+              "is forwarded to that peer instead of pulling the data.  "
+              "0 disables.")
 
 # ---------------------------------------------------------------------------
 
@@ -176,7 +190,7 @@ class _WorkerConn:
 class _ObjectState:
     __slots__ = ("status", "value", "error", "size", "locations",
                  "holders", "pins", "tracked", "creating_spec",
-                 "free_armed", "contains")
+                 "free_armed", "contains", "remote_inline")
 
     def __init__(self):
         # pending | inline | store | remote | error
@@ -198,6 +212,10 @@ class _ObjectState:
         # pinned while this entry lives (borrow pinning — an inner ref must
         # outlive the blob that mentions it, however long it sits unread).
         self.contains: Optional[List["ObjectID"]] = None
+        # "remote" objects: the directory says the remote copy is INLINE
+        # (small, lives in the holder raylet's memory, not its store) —
+        # such objects pull over the control plane, not the data channel.
+        self.remote_inline = False
 
 
 class _PeerConn:
@@ -480,6 +498,25 @@ class Raylet:
         self._pull_by_rid: Dict[int, ObjectID] = {}
         self._pull_rid = itertools.count(1)
         self._store = None  # raylet's own store client (pull serving/writing)
+        self._store_lock = threading.Lock()  # data-plane threads attach too
+        # ---- zero-copy data plane (data_channel.py + pull_manager.py) ----
+        self._data_server = None
+        self._pull_manager = None
+        if self.cluster_mode and store_path and config.data_channel:
+            from ray_tpu.core.data_channel import DataServer
+            from ray_tpu.core.pull_manager import PullManager
+
+            self._data_server = DataServer(node_ip, self._raylet_store)
+            self._pull_manager = PullManager(
+                self.node_id, self._raylet_store, self._peer_data_addr,
+                post=self.call_async,
+                on_done=self._on_pull_done, on_fail=self._on_pull_failed)
+        # Bounded sender pool for the python-fallback pull path (was: one
+        # thread spawned per pull request).
+        self._pull_send_q: Optional[_queue.SimpleQueue] = None
+        self._pull_sender_count = 0
+        self._m_pull_sender_saturated = 0
+        self._m_locality_spills = 0
 
         if isinstance(self.gcs, GcsCore):
             # In-process core: subscribe directly; pushes hop to the loop.
@@ -488,10 +525,12 @@ class Raylet:
             self.gcs.subscribe_remote(node_id=self.node_id)
         address = (node_ip, self.tcp_port) if self.cluster_mode else None
         self.node_labels = _node_topology_labels()
+        self.data_port = (self._data_server.port
+                          if self._data_server is not None else None)
         for info in self.gcs.register_node(
                 self.node_id, address, self.resources_total,
                 store_path=store_path, hostname=socket.gethostname(),
-                labels=self.node_labels):
+                labels=self.node_labels, data_port=self.data_port):
             if info["node_id"] != self.node_id and info["alive"]:
                 self._cluster_nodes[info["node_id"]] = info
 
@@ -509,6 +548,9 @@ class Raylet:
             self.call_async(
                 lambda: self.add_timer(config.internal_metrics_interval_s,
                                        self._flush_internal_metrics))
+        if self._pull_manager is not None:
+            self.call_async(
+                lambda: self.add_timer(1.0, self._pull_tick))
 
     # ------------------------------------------------------------------ API
     # Called from the driver thread; closures run on the event thread.
@@ -629,6 +671,10 @@ class Raylet:
                 self._tcp_listener.close()
             except OSError:
                 pass
+        if self._pull_manager is not None:
+            self._pull_manager.close()
+        if self._data_server is not None:
+            self._data_server.close()
         if self._store is not None:
             try:
                 self._store.close()
@@ -1206,7 +1252,7 @@ class Raylet:
                     self.node_id, (self.node_ip, self.tcp_port),
                     self.resources_total, store_path=self.store_path,
                     hostname=socket.gethostname(),
-                    labels=self.node_labels)
+                    labels=self.node_labels, data_port=self.data_port)
         except (ConnectionError, TimeoutError, OSError):
             pass
         if not self._shutdown:
@@ -1282,7 +1328,7 @@ class Raylet:
                        self.node_id, (self.node_ip, self.tcp_port),
                        self.resources_total, store_path=self.store_path,
                        hostname=socket.gethostname(),
-                       labels=self.node_labels)
+                       labels=self.node_labels, data_port=self.data_port)
         for oid, st in self._objects.items():
             if st.status == "store":
                 self._gcs_safe(self.gcs.add_object_location,
@@ -1311,6 +1357,8 @@ class Raylet:
             if st is not None and st.status == "pending":
                 st.status = "remote"
                 st.locations = [data["node_id"]]
+                st.size = max(st.size, data.get("size", 0))
+                st.remote_inline = bool(data.get("inline", False))
                 self._object_ready(oid)
             if oid in self._object_waiters or oid in self._dep_index:
                 self._maybe_pull(oid)
@@ -1346,6 +1394,10 @@ class Raylet:
 
     def _on_node_death(self, node_id: str, reason: str):
         self._cluster_nodes.pop(node_id, None)
+        if self._pull_manager is not None:
+            # data-plane pulls sourced from the dead node rotate to other
+            # holders (or fail back into _on_pull_failed for a re-lookup)
+            self._pull_manager.on_node_dead(node_id)
         peer = self._peers.pop(node_id, None)
         if peer is not None:
             try:
@@ -1506,9 +1558,14 @@ class Raylet:
             if st.status == "inline":
                 inline_deps[oid.hex()] = st.value
             elif st.status == "store":
-                store_deps[oid.hex()] = self.node_id
+                store_deps[oid.hex()] = (self.node_id, st.size)
             elif st.status == "remote" and st.locations:
-                store_deps[oid.hex()] = st.locations[0]
+                # ship EVERY known holder (multi-source striping seeds) +
+                # size for locality/admission math + the inline flag (an
+                # inline remote object must pull over the control plane —
+                # the holder's STORE can't serve it)
+                store_deps[oid.hex()] = (list(st.locations), st.size,
+                                         st.remote_inline)
         spec._acquired_pool = None
         spec._spill_count = getattr(spec, "_spill_count", 0) + 1
         self._forwarded[spec.task_id] = (spec, node_id)
@@ -1538,12 +1595,16 @@ class Raylet:
             oid = ObjectID.from_hex(h)
             if self._object_status(oid) not in ("inline", "store"):
                 self._object_inline(oid, blob)
-        for h, node in (msg.get("store_deps") or {}).items():
+        for h, dep in (msg.get("store_deps") or {}).items():
+            node, size = dep[0], dep[1]
             oid = ObjectID.from_hex(h)
             st = self._obj(oid)
             if st.status == "pending":
                 st.status = "remote"
-                st.locations = [node]
+                st.locations = list(node) if isinstance(node, list) else [node]
+                st.size = max(st.size, size or 0)
+                if len(dep) > 2:
+                    st.remote_inline = bool(dep[2])
         # Route the results back the moment every return resolves — this
         # catches every completion path (inline/store/error) with the same
         # machinery local get() uses.
@@ -1562,7 +1623,9 @@ class Raylet:
         contains = {}
         for h, r in results.items():
             if r[0] == "store":
-                out[h] = ("store", self.node_id)
+                st_out = self._objects.get(ObjectID.from_hex(h))
+                out[h] = ("store", self.node_id,
+                          st_out.size if st_out is not None else 0)
             else:
                 out[h] = r
             st = self._objects.get(ObjectID.from_hex(h))
@@ -1586,13 +1649,15 @@ class Raylet:
             elif r[0] == "error":
                 failed = True
                 self._object_error(oid, r[1])
-            else:  # ("store", node_id)
+            else:  # ("store", node_id, size)
                 st = self._obj(oid)
                 self._set_contains(st, contains.get(h))
                 if st.status in ("pending", "remote"):
                     st.status = "remote"
                     if r[1] not in st.locations:
                         st.locations.append(r[1])
+                    if len(r) > 2:
+                        st.size = max(st.size, r[2] or 0)
                     self._object_ready(oid)
         if spec is None:
             return
@@ -1651,22 +1716,75 @@ class Raylet:
     # ---- chunked object pulls (reference: pull_manager.h:52) ----
 
     def _raylet_store(self):
+        # Also called from data-plane server/receiver threads: guard the
+        # lazy attach so two threads never race two attachments.
         if self._store is None and self.store_path:
             from ray_tpu.core.object_store import ShmObjectStore
 
-            self._store = ShmObjectStore(self.store_path)
+            with self._store_lock:
+                if self._store is None:
+                    self._store = ShmObjectStore(self.store_path)
         return self._store
+
+    def _peer_data_addr(self, node_id: str):
+        """(host, data_port) of a peer's data-plane listener, or None when
+        unknown / the peer runs without a data channel.  Called from the
+        pull manager's DIALER thread (GcsClient calls are thread-safe;
+        _cluster_nodes updates are GIL-atomic dict ops); a channel-less
+        answer is tombstoned by the pull manager so it isn't re-queried
+        per pull."""
+        info = self._cluster_nodes.get(node_id)
+        if info is None or not info.get("data_port"):
+            info = self._gcs_safe(self.gcs.get_node, node_id)
+            if info is None or not info.get("alive"):
+                return None
+            self._cluster_nodes[node_id] = info
+        addr, port = info.get("address"), info.get("data_port")
+        if not addr or not port:
+            return None
+        return (addr[0], port)
+
+    # ---- bounded sender pool (python-fallback pull serving) ----
+
+    def _pull_sender_submit(self, fn):
+        """Queue a chunk-stream job onto the bounded sender pool (replaces
+        the old unbounded thread-per-request spawn).  Blocking sendalls
+        must stay off the event thread — two raylets pulling large objects
+        from each other would deadlock on full TCP buffers."""
+        if self._pull_send_q is None:
+            self._pull_send_q = _queue.SimpleQueue()
+        cap = max(1, config.pull_sender_threads)
+        if self._pull_send_q.qsize() >= cap and self._pull_sender_count >= cap:
+            self._m_pull_sender_saturated += 1
+        self._pull_send_q.put(fn)
+        if self._pull_sender_count < cap:
+            self._pull_sender_count += 1
+            threading.Thread(target=self._pull_sender_loop,
+                             name=f"pull-send-{self._pull_sender_count}",
+                             daemon=True).start()
+
+    def _pull_sender_loop(self):
+        q = self._pull_send_q
+        while not self._shutdown:
+            try:
+                fn = q.get(timeout=5.0)
+            except _queue.Empty:
+                continue
+            self._safe(fn)
 
     def _handle_pull(self, peer: _PeerConn, msg: dict):
         """Serve an object to a peer: inline blob in one frame, store bytes
         as a pull_meta + chunk stream.
 
-        The chunk stream is sent from a DEDICATED thread: a blocking
-        sendall on the event thread would stop this raylet from reading its
-        own sockets — two raylets pulling large objects from each other
-        would deadlock on full TCP buffers.  The store read is thread-safe
-        (pin via get_buffer / release when done); _objects is only touched
-        here on the event thread.
+        This is the python-fallback data path (inline objects, peers
+        without a data channel, RAY_TPU_DATA_CHANNEL=0); bulk store bytes
+        normally move over data_channel.py.  The chunk stream runs on the
+        BOUNDED SENDER POOL: a blocking sendall on the event thread would
+        stop this raylet from reading its own sockets — two raylets
+        pulling large objects from each other would deadlock on full TCP
+        buffers.  The store read is thread-safe (pin via get_buffer /
+        release when done); _objects is only touched here on the event
+        thread.
         """
         rid = msg["rid"]
         oid = ObjectID.from_hex(msg["id"])
@@ -1731,16 +1849,33 @@ class Raylet:
             except OSError:
                 self.call_async(self._drop_peer, peer)
 
-        threading.Thread(target=stream, name="pull-stream",
-                         daemon=True).start()
+        self._pull_sender_submit(stream)
 
-    def _maybe_pull(self, oid: ObjectID, force_lookup: bool = False):
-        """Start fetching a non-local object. Location from local metadata,
-        else the GCS directory (registering a watch when unknown)."""
+    def _maybe_pull(self, oid: ObjectID, force_lookup: bool = False,
+                    priority: int = 1):
+        """Start fetching a non-local object.  Location from local metadata,
+        else the GCS directory (registering a watch when unknown).
+
+        ``priority``: 0 = task-argument pull (admitted ahead of
+        speculative/get prefetch, which is 1) — only meaningful on the
+        pull-manager path.
+
+        Store objects normally move over the zero-copy data plane
+        (pull_manager striping across every known holder); inline objects
+        and peers without a data channel fall back to the single-source
+        pickled-chunk path below."""
         if not self.cluster_mode:
             return
         st = self._obj(oid)
         if st.status not in ("pending", "remote") or oid in self._pulls:
+            return
+        if (self._pull_manager is not None and not force_lookup
+                and self._pull_manager.active(oid)):
+            # already pulling: request() below would only dedup — but let a
+            # task-arg call bump a queued prefetch's admission priority
+            if priority == 0:
+                self._pull_manager.request(oid, st.size, list(st.locations),
+                                           priority=0)
             return
         if st.status == "pending" or force_lookup or not st.locations:
             loc = self._gcs_safe(self.gcs.get_object_locations, oid.hex(),
@@ -1750,9 +1885,21 @@ class Raylet:
             st.locations = [n for n in loc["nodes"] if n != self.node_id]
             if not st.locations:
                 return
+            st.size = max(st.size, loc.get("size", 0))
+            st.remote_inline = bool(loc.get("inline", False))
             if st.status == "pending":
                 st.status = "remote"
-        target = st.locations[0]
+        if (self._pull_manager is not None and config.data_channel
+                and not st.remote_inline):
+            if self._pull_manager.request(oid, st.size, list(st.locations),
+                                          priority=priority):
+                return
+            # no holder reachable on the data plane: fall through to the
+            # control-plane path (peer may predate the data channel)
+        # Randomize the holder so N concurrent pullers don't all hammer
+        # locations[0] (the multi-source data plane stripes instead; this
+        # is the single-channel fallback).
+        target = random.choice(st.locations)
         peer = self._get_peer(target)
         if peer is None:
             # Unreachable holder: drop it from the directory too (else a
@@ -1785,6 +1932,9 @@ class Raylet:
         pull = self._pulls[oid]
         pull["kind"] = msg["kind"]
         pull["size"] = msg["size"]
+        st_meta = self._objects.get(oid)
+        if st_meta is not None:
+            st_meta.size = max(st_meta.size, msg["size"])
         if msg["kind"] == "store" and msg["size"] > 0:
             store = self._raylet_store()
             try:
@@ -1865,6 +2015,43 @@ class Raylet:
                     st.status = "pending"
                     self._maybe_pull(oid, force_lookup=True)
 
+    # ---- data-plane pull callbacks (posted by the pull manager) ----
+
+    def _on_pull_done(self, oid: ObjectID):
+        """A data-plane pull sealed the object in the local store."""
+        st = self._obj(oid)
+        if st.status in ("pending", "remote"):
+            self._object_in_store(oid)
+
+    def _on_pull_failed(self, oid: ObjectID, bad_nodes: List[str]):
+        """Every data-plane source failed: scrub the dead holders from the
+        directory and re-resolve after a beat (mirrors _handle_pull_err);
+        the retry may pick fresh holders, or fall back to the
+        control-plane path when no data channel can be dialed."""
+        st = self._objects.get(oid)
+        if st is None or st.status not in ("pending", "remote"):
+            return
+        for node in bad_nodes:
+            if node in st.locations:
+                st.locations.remove(node)
+            self._gcs_post("remove_object_location", oid.hex(), node)
+        if oid not in self._object_waiters and oid not in self._dep_index:
+            return  # nobody is waiting anymore
+        if st.locations:
+            self._maybe_pull(oid)
+        else:
+            st.status = "pending"
+            self.add_timer(0.5, lambda: self._maybe_pull(
+                oid, force_lookup=True))
+
+    def _pull_tick(self):
+        """Repeating watchdog: stalled-range rotation + admission retries
+        for the pull manager (event thread)."""
+        if self._pull_manager is not None:
+            self._pull_manager.tick()
+        if not self._shutdown:
+            self.add_timer(1.0, self._pull_tick)
+
     def _remote_deps_pending(self, spec: TaskSpec) -> bool:
         """True when some dependency is not locally materialized — triggers
         the pulls; the task re-enters dispatch when they land.  ("pending"
@@ -1874,7 +2061,7 @@ class Raylet:
             st = self._objects.get(oid)
             status = st.status if st is not None else "pending"
             if status not in ("inline", "store", "error"):
-                self._maybe_pull(oid)
+                self._maybe_pull(oid, priority=0)  # task arg: high priority
                 pending = True
         return pending
 
@@ -2349,7 +2536,7 @@ class Raylet:
                 # A dep produced on another node resolves via the GCS
                 # directory watch the pull registers.
                 for oid in missing:
-                    self._maybe_pull(oid)
+                    self._maybe_pull(oid, priority=0)  # task args
         else:
             self._enqueue_ready(spec)
         self._schedule()
@@ -2563,6 +2750,17 @@ class Raylet:
                         deferred.append(spec)
                         no_progress += 1
                     continue
+                # Locality-aware placement (reference: locality_aware lease
+                # policy): a task whose arguments hold more bytes on a peer
+                # than here moves to the data instead of pulling the data.
+                if (not placement and spec.kind == NORMAL_TASK
+                        and getattr(spec, "_spill_count", 0)
+                        < config.spillback_max_hops):
+                    loc_target = self._locality_preferred_node(spec)
+                    if loc_target is not None \
+                            and self._forward_task(spec, loc_target):
+                        self._m_locality_spills += 1
+                        continue
             pool, need = self._task_resource_pools(spec)
             if pool is None:
                 # Distinguish "not schedulable yet" (pending PG, full
@@ -2656,7 +2854,11 @@ class Raylet:
                     fits_total = _fits(self.resources_total, need)
                     target = self._gcs_safe(
                         self.gcs.place_task, need,
-                        exclude=[self.node_id])
+                        exclude=[self.node_id],
+                        # locality hint: the GCS scores candidates by arg
+                        # bytes already on them (object directory sizes)
+                        arg_ids=[o.hex() for o in itertools.islice(
+                            spec.dependency_ids(), 16)] or None)
                     if target is None and not fits_total:
                         # nowhere has capacity free now; if some node could
                         # EVER fit it, forward there to queue
@@ -2782,6 +2984,42 @@ class Raylet:
             want = min(depth, cap - poolable.get(profile, 0)) - pending
             for _ in range(max(0, want)):
                 self._spawn_worker(profile)
+
+    def _locality_preferred_node(self, spec: TaskSpec) -> Optional[str]:
+        """Node holding strictly more bytes of this task's arguments than
+        are local here (and at least locality_aware_min_bytes) — the
+        scheduler moves large-arg tasks to the data.  Sizes come from the
+        object directory via xdone/object_at/pull metadata; unknown sizes
+        count as zero (never force a GCS round trip per schedule pass)."""
+        min_bytes = config.locality_aware_min_bytes
+        if min_bytes <= 0:
+            return None
+        local = 0
+        by_node: Dict[str, int] = {}
+        for oid in spec.dependency_ids():
+            st = self._objects.get(oid)
+            if st is None:
+                continue
+            if st.status in ("inline", "store"):
+                local += st.size or 0
+            elif st.status == "remote" and not st.remote_inline:
+                for n in st.locations:
+                    by_node[n] = by_node.get(n, 0) + (st.size or 0)
+        if not by_node:
+            return None
+        best, best_bytes = max(by_node.items(), key=lambda kv: kv[1])
+        if best_bytes < min_bytes or best_bytes <= local:
+            return None
+        info = self._cluster_nodes.get(best)
+        if info is None:
+            return None
+        total = info.get("resources_total")
+        # node_added pushes carry only id+address; with capacity unknown,
+        # forward optimistically — an infeasible target spills the task
+        # back (hop-capped) rather than suppressing locality entirely
+        if total is not None and not _fits(total, spec.resources or {}):
+            return None
+        return best
 
     def _dispatch_msg(self, spec: TaskSpec, conn: _WorkerConn,
                       running: bool = True) -> dict:
@@ -3616,6 +3854,35 @@ class Raylet:
                 "ray_tpu_internal_gcs_rpc_latency_s",
                 "Blocking GCS client RPC round-trip latency",
                 (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 1.0)),
+            # ---- data plane (pull manager / data channel) ----
+            "pull_inflight_bytes": gauge(
+                "ray_tpu_internal_pull_inflight_bytes",
+                "Bytes of admitted in-flight data-plane pulls"),
+            "pull_queued": gauge(
+                "ray_tpu_internal_pull_queued",
+                "Pulls waiting in the admission queue"),
+            "pull_active": gauge(
+                "ray_tpu_internal_pull_active",
+                "Admitted data-plane pulls in progress"),
+            "pull_bytes": counter(
+                "ray_tpu_internal_pull_bytes_total",
+                "Object bytes received over the data plane"),
+            "pull_chunks": counter(
+                "ray_tpu_internal_pull_chunks_total",
+                "Chunk ranges received over the data plane"),
+            "pull_source_switches": counter(
+                "ray_tpu_internal_pull_source_switches_total",
+                "Pull ranges rotated to another holder (stall/failure)"),
+            "pull_multi_source": counter(
+                "ray_tpu_internal_pull_multi_source_total",
+                "Completed pulls that striped across >= 2 holders"),
+            "pull_sender_saturated": counter(
+                "ray_tpu_internal_pull_sender_saturated_total",
+                "Fallback pull-serve submissions that queued behind a "
+                "fully busy sender pool"),
+            "locality_spills": counter(
+                "ray_tpu_internal_locality_spills_total",
+                "Tasks forwarded to the node holding their argument bytes"),
         }
         self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
         if isinstance(self.gcs, GcsClient):
@@ -3679,6 +3946,20 @@ class Raylet:
         bump(im["events_dropped"], "dropped", self._task_event_dropped_total)
         for st, n in self._m_tasks_done.items():
             bump(im["tasks_total"], f"tasks_{st}", n, tags={"state": st})
+        bump(im["pull_sender_saturated"], "pull_sat",
+             self._m_pull_sender_saturated)
+        bump(im["locality_spills"], "loc_spills", self._m_locality_spills)
+        if self._pull_manager is not None:
+            ps = self._pull_manager.stats()
+            im["pull_inflight_bytes"].set(ps["inflight_bytes"])
+            im["pull_queued"].set(ps["queued"])
+            im["pull_active"].set(ps["active"])
+            bump(im["pull_bytes"], "pull_bytes", ps["bytes_total"])
+            bump(im["pull_chunks"], "pull_chunks", ps["chunks_total"])
+            bump(im["pull_source_switches"], "pull_switch",
+                 ps["source_switches"])
+            bump(im["pull_multi_source"], "pull_multi",
+                 ps["multi_source_pulls"])
 
         import json as _json
 

@@ -95,6 +95,30 @@ def test_cross_node_dependency(cluster):
                        timeout=60) == 124999750000
 
 
+def test_locality_aware_placement_moves_task_to_data(cluster):
+    """A task whose (multi-MB) argument lives on node B runs ON node B even
+    with no resource constraint — the scheduler moves the task to the data
+    instead of pulling the data (reference: locality_aware leasing)."""
+
+    @ray_tpu.remote(resources={"special": 0.1})
+    def produce():
+        return np.ones(4 << 20, np.uint8)  # 4MB store object on node B
+
+    @ray_tpu.remote
+    def consume(x):
+        import os
+
+        return int(x[0]), os.environ.get("RAY_TPU_SESSION_DIR")
+
+    ref = produce.remote()
+    producer_session = ray_tpu.get(
+        _session_dir.options(resources={"special": 0.1}).remote(),
+        timeout=30)
+    val, consumer_session = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert val == 1
+    assert consumer_session == producer_session
+
+
 def test_named_actor_cross_node(cluster):
     @ray_tpu.remote(resources={"special": 0.2})
     class Holder:

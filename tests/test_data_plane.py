@@ -1,0 +1,264 @@
+"""Zero-copy data plane: dedicated transfer channels, the pull manager's
+multi-source striping / failover, and parity with the python fallback path.
+
+Uses IN-PROCESS raylets sharing one GcsCore (the same embedding the
+single-node runtime uses) so tests can seed stores directly and inspect
+pull-manager state — the subprocess cluster variants of these paths are
+covered by tests/test_cluster.py.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu.core.pull_manager  # noqa: F401 — registers pull_* flags
+from ray_tpu.core.config import config
+from ray_tpu.core.gcs import GcsCore
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import create_store_file
+from ray_tpu.core.raylet import Raylet, SimpleFuture
+
+
+def _make_raylet(tmp_path, name, core, store_mb=64):
+    sd = os.path.join(str(tmp_path), name)
+    os.makedirs(sd, exist_ok=True)
+    sp = os.path.join(sd, "store")
+    create_store_file(sp, store_mb << 20)
+    return Raylet(sd, {"CPU": 1}, sp, gcs=core, listen_port=0)
+
+
+def _seed(raylet, oid, data):
+    """Write sealed bytes into a raylet's store and register the location
+    (what a worker's register_stored does, minus the worker)."""
+    store = raylet._raylet_store()
+    mv = store.create(oid, len(data))
+    mv[:] = data
+    del mv
+    store.seal(oid)
+    store.release(oid)
+
+    def reg():
+        raylet._obj(oid).size = len(data)
+        raylet._object_in_store(oid)
+
+    raylet.call(reg).result(5)
+
+
+def _pull(raylet, oid, timeout=30):
+    """Drive a pull through the same async_get path get()/wait() use and
+    return the landed bytes from the local store."""
+    fut = SimpleFuture()
+    raylet.call(lambda: raylet.async_get([oid], fut.set)).result(5)
+    res = fut.result(timeout)
+    assert res[oid.hex()][0] == "store", res
+    store = raylet._raylet_store()
+    buf = store.get_buffer(oid)
+    if buf is None:  # landed via the spill-overflow path
+        assert store.has_spilled(oid)
+        with open(store._spill_path(oid), "rb") as f:
+            return f.read()
+    try:
+        return bytes(buf)
+    finally:
+        del buf
+        store.release(oid)
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    """Three cluster-mode raylets (a, b, c) on one shared GcsCore, small
+    stripe size so multi-MB objects split into many ranges."""
+    old = (config.pull_stripe_bytes, config.data_channel)
+    config.pull_stripe_bytes = 1 << 20
+    core = GcsCore()
+    core.start_health_monitor()
+    raylets = [_make_raylet(tmp_path, n, core) for n in "abc"]
+    time.sleep(0.3)  # node_added propagation
+    yield raylets
+    config.pull_stripe_bytes, config.data_channel = old
+    for r in raylets:
+        r.shutdown()
+    core.stop()
+
+
+def _rand(n):
+    return np.random.randint(0, 255, n, np.uint8).tobytes()
+
+
+def test_parity_python_fallback_vs_zero_copy(trio):
+    """Both data paths must land byte-identical objects (the fallback is
+    also what peers without a data channel negotiate down to)."""
+    a, b, c = trio
+    data = _rand(5 << 20)
+
+    oid_fast = ObjectID.from_random()
+    _seed(a, oid_fast, data)
+    assert _pull(b, oid_fast) == data
+    assert b._pull_manager.stats()["completed"] >= 1
+
+    config.data_channel = False
+    try:
+        oid_slow = ObjectID.from_random()
+        _seed(a, oid_slow, data)
+        before = c._pull_manager.stats()["completed"]
+        assert _pull(c, oid_slow) == data
+        # the fallback path must not have gone through the pull manager
+        assert c._pull_manager.stats()["completed"] == before
+    finally:
+        config.data_channel = True
+
+
+def test_pull_stripes_across_two_holders(trio):
+    """With two holders in the directory, one pull stripes chunk ranges
+    across BOTH (asserted via pull-manager state — the same numbers the
+    ray_tpu_internal_pull_* series export)."""
+    a, b, c = trio
+    data = _rand(16 << 20)
+    oid = ObjectID.from_random()
+    _seed(a, oid, data)
+    _seed(b, oid, data)
+    assert _pull(c, oid) == data
+    st = c._pull_manager.stats()
+    assert st["multi_source_pulls"] >= 1
+    sources = st["last_completed"]["sources"]
+    assert len(sources) == 2, sources
+    assert all(n > 0 for n in sources.values())
+    assert sum(sources.values()) == len(data)
+    assert st["chunks_total"] >= 16  # 1MB stripes over 16MB
+
+
+def test_holder_dies_mid_stream_resumes_from_replica(trio):
+    """Kill a holder's data server while its ranges are in flight: the
+    pull rotates the lost ranges to the surviving replica and completes
+    (reference: pull retry with location re-resolution)."""
+    a, b, c = trio
+    data = _rand(16 << 20)
+    oid = ObjectID.from_random()
+    _seed(a, oid, data)
+    _seed(b, oid, data)
+    a._data_server.serve_delay_s = 0.15  # keep A's ranges in flight
+    fut = SimpleFuture()
+    c.call(lambda: c.async_get([oid], fut.set)).result(5)
+    time.sleep(0.05)
+    a._data_server.close()  # holder dies mid-stream
+    res = fut.result(30)
+    assert res[oid.hex()][0] == "store"
+    store = c._raylet_store()
+    buf = store.get_buffer(oid)
+    try:
+        assert bytes(buf) == data
+    finally:
+        del buf
+        store.release(oid)
+    st = c._pull_manager.stats()
+    assert st["source_switches"] >= 1
+    assert st["last_completed"]["sources"].get(b.node_id, 0) > 0
+
+
+def test_cross_node_pull_of_spilled_object(tmp_path):
+    """An object that overflowed a holder's arena to disk streams out over
+    the data channel's sendfile path, byte-identical."""
+    core = GcsCore()
+    core.start_health_monitor()
+    holder = _make_raylet(tmp_path, "holder", core, store_mb=4)
+    puller = _make_raylet(tmp_path, "puller", core, store_mb=64)
+    try:
+        time.sleep(0.3)
+        data = _rand(8 << 20)  # 2x the holder's arena
+        oid = ObjectID.from_random()
+        holder._raylet_store().spill_raw(oid, data)
+        assert holder._raylet_store().has_spilled(oid)
+
+        def reg():
+            holder._obj(oid).size = len(data)
+            holder._object_in_store(oid)
+
+        holder.call(reg).result(5)
+        assert _pull(puller, oid) == data
+    finally:
+        holder.shutdown()
+        puller.shutdown()
+        core.stop()
+
+
+def test_task_arg_pull_admitted_ahead_of_prefetch(trio):
+    """Admission is FIFO+priority: with the in-flight cap forcing queueing,
+    a later task-argument pull (priority 0) overtakes earlier queued
+    get-prefetch pulls (priority 1)."""
+    a, b, c = trio
+    old_cap = config.pull_max_inflight_bytes
+    config.pull_max_inflight_bytes = 1  # everything beyond pull #1 queues
+    try:
+        blobs = {}
+        for _ in range(3):
+            oid = ObjectID.from_random()
+            blobs[oid] = _rand(2 << 20)
+            _seed(a, oid, blobs[oid])
+        oids = list(blobs)
+        futs = {o: SimpleFuture() for o in oids}
+        order = []
+
+        def mk_cb(o):
+            def cb(res):
+                order.append(o)  # event-thread completion order
+                futs[o].set(res)
+            return cb
+
+        def start():
+            # two prefetch-priority pulls queue behind the first admitted
+            c.async_get([oids[0]], mk_cb(oids[0]))
+            c.async_get([oids[1]], mk_cb(oids[1]))
+            # arg-priority request for the LAST oid jumps the queue
+            c._maybe_pull(oids[2], priority=0)
+            c.async_get([oids[2]], mk_cb(oids[2]))
+
+        c.call(start).result(5)
+        for o in oids:
+            futs[o].result(30)
+        # the task-arg pull overtook the earlier-queued prefetch
+        assert order.index(oids[2]) < order.index(oids[1]), order
+        for o in oids:
+            st = c._raylet_store()
+            buf = st.get_buffer(o)
+            assert bytes(buf) == blobs[o]
+            del buf
+            st.release(o)
+    finally:
+        config.pull_max_inflight_bytes = old_cap
+
+
+def test_spill_tmp_names_are_unique_per_call(tmp_path):
+    """Regression: two threads of one process spilling the same object id
+    must not collide on the .tmp file (pid-only suffix race)."""
+    import threading
+
+    from ray_tpu.core.object_store import ShmObjectStore
+
+    sp = os.path.join(str(tmp_path), "store")
+    create_store_file(sp, 4 << 20)
+    store = ShmObjectStore(sp)
+    oid = ObjectID.from_random()
+    data = _rand(1 << 20)
+    errors = []
+
+    def spill():
+        try:
+            for _ in range(10):
+                store.spill_raw(oid, data)
+        except OSError as e:  # pragma: no cover — the race being tested
+            errors.append(e)
+
+    threads = [threading.Thread(target=spill) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    with open(store._spill_path(oid), "rb") as f:
+        assert f.read() == data
+    # no leftover tmp files
+    leftovers = [f for f in os.listdir(store._spill_dir) if ".tmp" in f]
+    assert not leftovers
+    store.close()
