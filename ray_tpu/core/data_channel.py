@@ -41,6 +41,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.protocol import recv_exact as _recv_exact
 from ray_tpu.core.protocol import recv_into_exact
+from ray_tpu.util import chaos as _chaos
 
 MAGIC = b"RTDP\x01\x00\x00\x00"
 
@@ -124,6 +125,7 @@ class DataServer:
 
     def _serve_conn(self, sock: socket.socket):
         key = sock.fileno()
+        blackholed = False  # chaos partition: requests drain, replies vanish
         try:
             magic = _recv_exact(sock, len(MAGIC))
             if magic is None or bytes(magic) != MAGIC:
@@ -134,6 +136,14 @@ class DataServer:
                     return
                 op, rid, offset, length, oid_bytes = _REQ.unpack(bytes(hdr))
                 oid = ObjectID(oid_bytes)
+                if not blackholed:
+                    fault = _chaos.net_fault("data")
+                    if fault == "blackhole":
+                        blackholed = True
+                    if fault is not None:
+                        continue  # this response is swallowed by the chaos
+                else:
+                    continue
                 if op == OP_META:
                     self._serve_meta(sock, rid, oid)
                 elif op == OP_READ:
@@ -295,6 +305,7 @@ class DataChannel:
         self._send_lock = threading.Lock()
         self._sinks: Dict[int, memoryview] = {}
         self._sinks_lock = threading.Lock()
+        self._chaos_blackholed = False
         self.alive = True
         self._recv_thread = threading.Thread(
             target=self._recv_loop, name=f"data-recv-{node_id[:8]}",
@@ -329,6 +340,14 @@ class DataChannel:
     def _send(self, data: bytes) -> bool:
         if not self.alive:
             return False
+        if self._chaos_blackholed:
+            return True  # partitioned: the request silently vanishes
+        fault = _chaos.net_fault("data")
+        if fault is not None:
+            if fault == "blackhole":
+                self._chaos_blackholed = True
+            # dropped request: the pull watchdog rotates/retries the range
+            return True
         try:
             with self._send_lock:
                 self._sock.sendall(data)

@@ -322,9 +322,16 @@ class GcsCore:
             if info is None or not info["alive"]:
                 return
             info["alive"] = False
-            # prune the directory: bytes on a dead node are gone
-            for entry in self._objects.values():
+            # prune the directory: bytes on a dead node are gone.  Entries
+            # with no holder left are DELETED, not kept with stale
+            # metadata — their max()-accumulated size must not outlive the
+            # last copy (a reconstruction may re-seal the object smaller,
+            # and a stale larger size would drive out-of-range pull reads
+            # that scrub valid holders).
+            for oid, entry in list(self._objects.items()):
                 entry["nodes"].discard(node_id)
+                if not entry["nodes"]:
+                    del self._objects[oid]
         self._publish("node_dead", {"node_id": node_id, "reason": reason})
         self._repair_pgs_for_dead_node(node_id)
 
@@ -696,6 +703,21 @@ class GcsCore:
             else:
                 self._mark_dirty()
 
+    def kv_multi_put(self, ns: str, items):
+        """Batched kv_put: one RPC/post for N keys of one namespace (the
+        raylets' internal-metrics flush ships ~30 keys per interval —
+        per-key posts were 30x the control-plane frames for the same
+        data)."""
+        with self._lock:
+            now = time.monotonic()
+            soft = ns in self._SOFT_KV_NS
+            for key, val in items:
+                self._kv[(ns, key)] = val
+                if soft:
+                    self._kv_soft_ts[(ns, key)] = now
+            if items and not soft:
+                self._mark_dirty()
+
     def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         with self._lock:
             return self._kv.get((ns, key))
@@ -939,7 +961,7 @@ class GcsCore:
 _OPS = {
     "register_node", "unregister_node", "heartbeat", "nodes", "get_node",
     "place_task", "feasible_nodes", "load_metrics", "drain_node",
-    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "kv_put", "kv_multi_put", "kv_get", "kv_del", "kv_keys",
     "put_function", "get_function",
     "register_actor", "update_actor", "remove_actor", "get_actor",
     "lookup_named_actor", "list_actors",

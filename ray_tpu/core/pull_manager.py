@@ -28,6 +28,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ray_tpu.core.config import config
 from ray_tpu.core.data_channel import DataChannel
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.util.retry import BackoffPolicy
+
+config.define("data_dial_attempts", int, 3,
+              "Connect attempts per holder when dialing a data channel "
+              "(unified jittered-exponential backoff between attempts) "
+              "before the holder is tombstoned off the data plane.")
 
 config.define("pull_max_inflight_bytes", int, 256 << 20,
               "Admission cap on total bytes of in-flight object pulls "
@@ -311,10 +317,14 @@ class PullManager:
     def _dial(self, locations: List[str]) -> List[DataChannel]:
         """Connect (or reuse) data channels for up to pull_max_sources
         holders.  Runs on the DIALER thread (blocking connects must stay
-        off the raylet event loop); nodes that can't be dialed — no
-        data_port registered, or the connect failed — get a tombstone so
-        callers stop retrying the data plane against them for a while."""
+        off the raylet event loop).  Each holder gets
+        ``data_dial_attempts`` connects under the unified backoff policy
+        (a restarting peer often accepts on the second try); nodes that
+        still can't be dialed — no data_port registered, or every connect
+        failed — get a tombstone so callers stop retrying the data plane
+        against them for a while."""
         out = []
+        policy = BackoffPolicy()
         for node in locations[:max(1, config.pull_max_sources)]:
             chan = self._channels.get(node)
             if chan is not None and chan.alive:
@@ -324,9 +334,27 @@ class PullManager:
             if addr is None:
                 self._no_data_plane[node] = time.monotonic() + 30.0
                 continue
-            try:
-                chan = DataChannel(node, addr, self._on_event)
-            except OSError:
+            chan = None
+            for attempt in range(max(1, config.data_dial_attempts)):
+                if self._closed:
+                    return out
+                try:
+                    chan = DataChannel(node, addr, self._on_event)
+                    break
+                except (ConnectionRefusedError, TimeoutError):
+                    # Refused: the peer process is gone.  Timeout: the
+                    # HOST is gone (preemption — the dominant failure on
+                    # the target fleet) and already cost connect_timeout.
+                    # Retrying either is futile, and every sleep here
+                    # serializes in front of all other queued dials on
+                    # this (single) dialer thread.
+                    break
+                except OSError:
+                    # reset/unreachable (fast failures): plausibly a
+                    # restarting peer — retry under the unified backoff
+                    if attempt + 1 < max(1, config.data_dial_attempts):
+                        time.sleep(policy.delay(attempt))
+            if chan is None:
                 self._no_data_plane[node] = time.monotonic() + 30.0
                 continue
             self._channels[node] = chan

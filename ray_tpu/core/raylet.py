@@ -58,6 +58,8 @@ from ray_tpu.core.task_spec import (
     STREAMING_RETURNS,
     TaskSpec,
 )
+from ray_tpu.util import chaos as _chaos
+from ray_tpu.util.retry import BackoffPolicy
 
 config.define("gcs_reconnect_timeout_s", float, 0.0,
               "GCS fault tolerance: on a lost GCS connection, retry "
@@ -87,6 +89,17 @@ config.define("ref_free_grace_s", float, 2.0,
 config.define("max_lineage_entries", int, 20000,
               "Max objects whose creating TaskSpec is retained for "
               "eviction recovery (reference: lineage byte caps).")
+config.define("max_object_reconstructions", int, 5,
+              "Per-object lineage-reconstruction budget (reference: "
+              "RAY_max_object_reconstructions / task max_retries): how "
+              "many times a lost object's creating task may be re-run "
+              "before get() raises ObjectLostError.  Each reconstruction "
+              "also draws down the spec's retries_left, so crash retries "
+              "and reconstructions share one budget.")
+config.define("max_reconstruction_depth", int, 8,
+              "Recursion bound for reconstructing an object's missing "
+              "dependencies (a lineage chain deeper than this errors "
+              "instead of re-running unboundedly).")
 config.define("pull_sender_threads", int, 2,
               "Bounded sender pool for the python-fallback pull path "
               "(control-plane chunk streams).  A burst of pulls queues "
@@ -190,7 +203,8 @@ class _WorkerConn:
 class _ObjectState:
     __slots__ = ("status", "value", "error", "size", "locations",
                  "holders", "pins", "tracked", "creating_spec",
-                 "free_armed", "contains", "remote_inline")
+                 "free_armed", "contains", "remote_inline",
+                 "recon_attempts", "lookup_attempts")
 
     def __init__(self):
         # pending | inline | store | remote | error
@@ -216,20 +230,39 @@ class _ObjectState:
         # (small, lives in the holder raylet's memory, not its store) —
         # such objects pull over the control plane, not the data channel.
         self.remote_inline = False
+        # Lineage-reconstruction budget spent on this object (node death /
+        # eviction re-runs of creating_spec); capped by
+        # config.max_object_reconstructions.
+        self.recon_attempts = 0
+        # Consecutive failed directory re-lookups — drives the unified
+        # backoff on pull retries; reset when the object materializes.
+        self.lookup_attempts = 0
 
 
 class _PeerConn:
     """Connection to another raylet (either dialed or accepted)."""
 
-    __slots__ = ("sock", "node_id", "send_lock", "rbuf")
+    __slots__ = ("sock", "node_id", "send_lock", "rbuf", "blackholed")
 
     def __init__(self, sock, node_id: str):
         self.sock = sock
         self.node_id = node_id
         self.send_lock = threading.Lock()
         self.rbuf = bytearray()  # partial-frame receive buffer
+        # Chaos blackhole: a partitioned peer conn silently swallows every
+        # outbound frame (the socket stays open — failure detection must
+        # come from the GCS health monitor / pull watchdogs, like a real
+        # network partition).
+        self.blackholed = False
 
     def send(self, msg):
+        if self.blackholed:
+            return
+        fault = _chaos.net_fault("peer")
+        if fault is not None:
+            if fault == "blackhole":
+                self.blackholed = True
+            return  # drop / blackhole: the frame vanishes
         protocol.send_msg(self.sock, msg, self.send_lock)
 
 
@@ -517,6 +550,14 @@ class Raylet:
         self._pull_sender_count = 0
         self._m_pull_sender_saturated = 0
         self._m_locality_spills = 0
+        # Lineage-reconstruction accounting (node-death + eviction recovery)
+        self._m_recon_attempts = 0
+        self._m_recon_successes = 0
+        self._m_recon_failures = 0
+        # Unified jittered-exponential backoff for transient-failure paths
+        # (GCS reconnect, pull re-lookups; data-channel dials hold their
+        # own instance inside the pull manager).
+        self._retry_policy = BackoffPolicy()
 
         if isinstance(self.gcs, GcsCore):
             # In-process core: subscribe directly; pushes hop to the loop.
@@ -1285,13 +1326,15 @@ class Raylet:
             self._safe(self.on_fatal)
 
     def _gcs_reconnect_loop(self):
-        """Reader-thread side: dial the (restarted) GCS until the timeout,
-        then hand over to the event loop to re-register and re-publish
-        this node's object locations."""
+        """Reader-thread side: dial the (restarted) GCS until the timeout
+        under the unified jittered-exponential backoff, then hand over to
+        the event loop to re-register and re-publish this node's object
+        locations."""
         deadline = time.monotonic() + config.gcs_reconnect_timeout_s
         sys.stderr.write(
             f"[ray_tpu] node {self.node_id[:8]}: GCS connection lost — "
             f"reconnecting for up to {config.gcs_reconnect_timeout_s:.0f}s\n")
+        attempt = 0
         while time.monotonic() < deadline and not self._shutdown:
             try:
                 new_gcs = GcsClient(self.gcs_address,
@@ -1299,7 +1342,9 @@ class Raylet:
                                     on_disconnect=self._on_gcs_lost)
                 break
             except (ConnectionError, OSError):
-                time.sleep(0.25)
+                time.sleep(min(self._retry_policy.delay(attempt),
+                               max(0.0, deadline - time.monotonic())))
+                attempt += 1
         else:
             if not self._shutdown:
                 config.gcs_reconnect_timeout_s = 0.0  # no second chance
@@ -1417,18 +1462,11 @@ class Raylet:
                 if st is not None and node_id in st.locations:
                     st.locations.remove(node_id)
                 self._maybe_pull(oid, force_lookup=True)
-        # Remote objects whose only copy died: lost (lineage reconstruction
-        # re-runs the creating task when ownership tracking lands).
-        for oid, st in list(self._objects.items()):
-            if st.status != "remote":
-                continue
-            if node_id in st.locations:
-                st.locations.remove(node_id)
-            if not st.locations:
-                self._object_error(oid, ObjectLostError(
-                    f"object {oid.hex()} was on node {node_id} which died"))
         # Forwarded tasks: retry like a worker crash (actor tasks fail — the
-        # actor itself restarts below and interrupted calls error).
+        # actor itself restarts below and interrupted calls error).  Runs
+        # BEFORE the lost-object scan so objects those retries will
+        # re-produce register as in-flight and aren't double-submitted by
+        # dependency reconstruction.
         for tid, (spec, nid) in list(self._forwarded.items()):
             if nid != node_id:
                 continue
@@ -1452,6 +1490,29 @@ class Raylet:
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
                 self._record_event(spec, "FAILED", node_died=True)
+        # Remote objects whose only copy died with the node: lineage
+        # reconstruction re-runs the creating task (reference:
+        # ObjectRecoveryManager on node failure, object_recovery_manager.cc)
+        # — ObjectLostError only when lineage is absent or the
+        # reconstruction budget is exhausted.  Waiters blocked in get()
+        # and dep-gated tasks stay registered: the object drops back to
+        # "pending" and resolves when the re-run seals it.
+        lost: List[ObjectID] = []
+        for oid, st in list(self._objects.items()):
+            if st.status != "remote":
+                continue
+            if node_id in st.locations:
+                st.locations.remove(node_id)
+            if not st.locations:
+                lost.append(oid)
+        for oid in lost:
+            st = self._objects.get(oid)
+            if st is None or st.status != "remote" or st.locations:
+                continue  # a sibling's reconstruction already reset it
+            if self.reconstruct_object(oid):
+                continue
+            self._object_error(oid, self._lost_error(
+                oid, st, f"was on node {node_id} which died"))
         # Actors executing on the dead node: restart per budget.
         for actor in list(self._actors.values()):
             if actor.node_id == node_id and actor.state != "dead":
@@ -1911,8 +1972,7 @@ class Raylet:
                 self._maybe_pull(oid)
             else:
                 st.status = "pending"
-                self.add_timer(0.5, lambda: self._maybe_pull(
-                    oid, force_lookup=True))
+                self._recover_or_retry(oid, st)
             return
         rid = next(self._pull_rid)
         self._pulls[oid] = {"rid": rid, "node": target, "kind": None,
@@ -2013,7 +2073,7 @@ class Raylet:
                     self._maybe_pull(oid)
                 else:
                     st.status = "pending"
-                    self._maybe_pull(oid, force_lookup=True)
+                    self._recover_or_retry(oid, st)
 
     # ---- data-plane pull callbacks (posted by the pull manager) ----
 
@@ -2025,9 +2085,10 @@ class Raylet:
 
     def _on_pull_failed(self, oid: ObjectID, bad_nodes: List[str]):
         """Every data-plane source failed: scrub the dead holders from the
-        directory and re-resolve after a beat (mirrors _handle_pull_err);
-        the retry may pick fresh holders, or fall back to the
-        control-plane path when no data channel can be dialed."""
+        directory and re-resolve with backoff (mirrors _handle_pull_err);
+        the retry may pick fresh holders, fall back to the control-plane
+        path when no data channel can be dialed — or, when no holder
+        exists anywhere anymore, reconstruct from lineage."""
         st = self._objects.get(oid)
         if st is None or st.status not in ("pending", "remote"):
             return
@@ -2039,10 +2100,46 @@ class Raylet:
             return  # nobody is waiting anymore
         if st.locations:
             self._maybe_pull(oid)
-        else:
-            st.status = "pending"
-            self.add_timer(0.5, lambda: self._maybe_pull(
-                oid, force_lookup=True))
+            return
+        st.status = "pending"
+        self._recover_or_retry(oid, st)
+
+    def _recover_or_retry(self, oid: ObjectID, st: "_ObjectState"):
+        """A previously sealed object has no reachable holder left.  Order
+        of recovery: (1) re-resolve the directory — another live node may
+        hold a copy this raylet hasn't heard of; (2) reconstruct from
+        lineage; (3) no lineage (ray.put / actor result): retry the
+        lookup with backoff — a holder may still re-register (e.g. after
+        a GCS restart).  When lineage exists but reconstruction is
+        impossible (budget exhausted, unrecoverable dependency), the
+        object errors NOW so waiters raise ObjectLostError instead of
+        hanging on a directory watch that can never fire."""
+        loc = self._gcs_err_ok(self.gcs.get_object_locations, oid.hex(),
+                               watcher=self.node_id)
+        if loc is not _GCS_ERR:
+            nodes = [n for n in (loc or {}).get("nodes", ())
+                     if n != self.node_id and n in self._cluster_nodes]
+            if nodes:
+                # Retry via a backoff timer, not inline: the directory may
+                # still list a dying node the health monitor hasn't pruned
+                # yet, and an inline _maybe_pull would mutually recurse
+                # through this path until it is.
+                st.locations = nodes
+                st.status = "remote"
+                st.lookup_attempts += 1
+                self.add_timer(
+                    self._retry_policy.delay(st.lookup_attempts - 1),
+                    lambda: self._maybe_pull(oid))
+                return
+            if st.creating_spec is not None:
+                if not self.reconstruct_object(oid):
+                    self._object_error(oid, self._lost_error(
+                        oid, st, "has no reachable copy left"))
+                return
+        # GCS unreachable, or reachable but no lineage: backoff retry
+        st.lookup_attempts += 1
+        self.add_timer(self._retry_policy.delay(st.lookup_attempts - 1),
+                       lambda: self._maybe_pull(oid, force_lookup=True))
 
     def _pull_tick(self):
         """Repeating watchdog: stalled-range rotation + admission retries
@@ -2206,14 +2303,102 @@ class Raylet:
 
         self.async_get(spec.return_ids(), unpin)
 
+    def _lost_error(self, oid: ObjectID, st: Optional["_ObjectState"],
+                    why: str) -> ObjectLostError:
+        """ObjectLostError whose message says WHY recovery didn't run:
+        missing lineage vs an exhausted reconstruction budget."""
+        spec = st.creating_spec if st is not None else None
+        if spec is None:
+            detail = ("no lineage retained (ray.put / actor result, or "
+                      "the lineage cap evicted it)")
+        elif (st.recon_attempts >= config.max_object_reconstructions
+                or spec.retries_left <= 0):
+            detail = (f"reconstruction budget exhausted after "
+                      f"{st.recon_attempts} reconstruction(s) "
+                      f"(max_object_reconstructions="
+                      f"{config.max_object_reconstructions}, "
+                      f"retries_left={max(0, spec.retries_left)})")
+        else:
+            detail = ("a dependency could not be recovered (missing "
+                      "lineage, errored, or reconstruction depth cap)")
+        return ObjectLostError(f"object {oid.hex()} {why}; {detail}")
+
+    def _task_in_flight(self, tid: TaskID) -> bool:
+        """Is the task currently producing its returns (queued, dep-gated,
+        forwarded, dispatched, or already reconstructing)?  Used to avoid
+        double-submitting a creating task during recovery."""
+        if (tid in self._reconstructing or tid in self._waiting
+                or tid in self._forwarded):
+            return True
+        if any(s.task_id == tid for s in self._ready_queue):
+            return True
+        if any(tid in c.inflight for c in self._workers.values()):
+            return True
+        return any(tid in a.inflight
+                   or any(s.task_id == tid for s in a.queue)
+                   for a in self._actors.values())
+
+    def _live_locations(self, st: "_ObjectState") -> List[str]:
+        return [n for n in st.locations
+                if n == self.node_id or n in self._cluster_nodes]
+
+    def _dep_recoverable(self, dep: ObjectID, store, _depth: int) -> bool:
+        """Ensure one dependency of a task being reconstructed is (or will
+        become) materializable: live remote holders first, then the GCS
+        directory, then recursive reconstruction — including deps whose
+        only copy died with a node.  An unrecoverable dep is ERRORED here
+        (not just reported False): its own waiters must raise rather than
+        hang, and the node-death scan won't revisit it once its status
+        left "remote"."""
+        ds = self._objects.get(dep)
+        status = ds.status if ds is not None else "pending"
+        if status == "inline":
+            return True
+        if status == "error":
+            return False  # re-running the parent can only re-fail
+        if status == "store":
+            if store is None or store.contains(dep):
+                return True  # bytes are present locally
+        elif status == "remote":
+            if self._live_locations(ds):
+                return True  # another live holder; dispatch-time pull
+            # re-resolve across the cluster: the directory may know
+            # holders this raylet hasn't heard of.  A transient GCS
+            # failure is NOT "no holders" — leave the dep alone and let
+            # the dispatch-time pull retry through the backoff paths.
+            loc = self._gcs_err_ok(self.gcs.get_object_locations,
+                                   dep.hex(), watcher=self.node_id)
+            if loc is _GCS_ERR:
+                return True
+            nodes = [n for n in (loc or {}).get("nodes", ())
+                     if n == self.node_id or n in self._cluster_nodes]
+            if nodes:
+                ds.locations = [n for n in nodes if n != self.node_id] \
+                    or nodes
+                return True
+            ds.status = "pending"
+            ds.locations = []
+        elif status == "pending" and self._task_in_flight(dep.task_id()):
+            return True  # producer in flight; dependency gating waits
+        if self.reconstruct_object(dep, _depth + 1):
+            return True
+        self._object_error(dep, self._lost_error(
+            dep, self._objects.get(dep), "has no reachable copy left"))
+        return False
+
     def reconstruct_object(self, oid: ObjectID, _depth: int = 0) -> bool:
         """Lineage reconstruction (reference: ObjectRecoveryManager,
         `object_recovery_manager.h:41`): re-run the task that created an
-        object whose bytes were evicted; missing dependencies reconstruct
-        recursively (bounded depth)."""
+        object whose bytes were evicted — or whose only copy died with a
+        node — under the per-object reconstruction budget.  Missing
+        dependencies re-resolve across the cluster (live holders first)
+        or reconstruct recursively (bounded depth).  Returns False when
+        lineage is absent or the budget is exhausted; the caller raises
+        ObjectLostError."""
         st = self._objects.get(oid)
         spec = st.creating_spec if st is not None else None
-        if spec is None or spec.kind != NORMAL_TASK or _depth > 8:
+        if (spec is None or spec.kind != NORMAL_TASK
+                or _depth > config.max_reconstruction_depth):
             return False
         if spec.task_id in self._reconstructing:
             return True  # already re-running; the waiter resolves with it
@@ -2221,28 +2406,61 @@ class Raylet:
         if (st.status == "store" and store is not None
                 and store.contains(oid)):
             return True  # false alarm: bytes are present
+        if st.status == "remote" and self._live_locations(st):
+            return True  # a live holder remains: pull, don't re-run
+        if self._task_in_flight(spec.task_id):
+            # Creating task already re-queued/dispatched — e.g. the
+            # forwarded-task retry loop re-enqueued it in this same
+            # node-death pass (the return can still read "remote" with no
+            # locations then).  Submitting again would run the task twice
+            # concurrently and burn two budget units for one death.
+            return True
+        # ---- budget: reconstructions are capped per object AND draw down
+        # the spec's retries_left, so crash-retries + reconstruction share
+        # one budget (reference: task max_retries bounds both).
+        if (st.recon_attempts >= config.max_object_reconstructions
+                or spec.retries_left <= 0):
+            return False
+        # Dependency check BEFORE resetting the return objects: an
+        # unrecoverable dep aborts reconstruction, and sibling returns
+        # that are still sealed (e.g. in the local store) must keep their
+        # status — resetting them first would strand them "pending".
+        for dep in spec.dependency_ids():
+            if not self._dep_recoverable(dep, store, _depth):
+                return False
         for rid in spec.return_ids():
             s2 = self._obj(rid)
             if s2.status in ("store", "remote"):
                 s2.status = "pending"
                 s2.locations = []
-        for dep in spec.dependency_ids():
-            ds = self._objects.get(dep)
-            if ds is None or ds.status == "pending":
-                if not self.reconstruct_object(dep, _depth + 1):
-                    return False
-            elif ds.status == "store" and store is not None \
-                    and not store.contains(dep):
-                if not self.reconstruct_object(dep, _depth + 1):
-                    return False
+                # the re-run may produce different bytes (nondeterministic
+                # task): stale sizes must not skip the next pull's META
+                s2.size = 0
+                s2.remote_inline = False
+        for rid in spec.return_ids():
+            self._obj(rid).recon_attempts += 1
+        spec.retries_left -= 1
         spec._acquired_pool = None
+        spec._spill_count = 0  # fresh placement budget for the re-run
+        self._m_recon_attempts += 1
+        if self._im is not None:
+            self._im["recon_depth"].observe(_depth)
         self._reconstructing.add(spec.task_id)
-        self.async_get(
-            spec.return_ids(),
-            lambda _r, t=spec.task_id: self._reconstructing.discard(t))
-        self._record_event(spec, "RECONSTRUCTING")
+        self.async_get(spec.return_ids(),
+                       lambda results, s=spec: self._on_recon_done(s, results))
+        self._record_event(spec, "RECONSTRUCTING", depth=_depth)
         self.submit_task(spec)
         return True
+
+    def _on_recon_done(self, spec: TaskSpec, results: Dict[str, tuple]):
+        """All returns of a reconstruction attempt resolved (sealed or
+        errored) — close out the attempt and count the outcome."""
+        self._reconstructing.discard(spec.task_id)
+        if any(r[0] == "error" for r in results.values()):
+            self._m_recon_failures += 1
+        else:
+            self._m_recon_successes += 1
+            self._record_event(spec, "RECONSTRUCTED")
 
     # --------------------------------------------------------------- streams
 
@@ -2456,6 +2674,7 @@ class Raylet:
         # transition keeps waiters registered (they resolve when the pull
         # seals the object here) but must kick the pull off.
         if status in ("inline", "store", "error"):
+            st.lookup_attempts = 0  # backoff resets once materialized
             for cb in self._object_waiters.pop(oid, []):
                 self._safe(lambda cb=cb: cb(oid))
         elif status == "remote" and oid in self._object_waiters:
@@ -3883,6 +4102,21 @@ class Raylet:
             "locality_spills": counter(
                 "ray_tpu_internal_locality_spills_total",
                 "Tasks forwarded to the node holding their argument bytes"),
+            # ---- lineage reconstruction (node death / eviction recovery) --
+            "recon_attempts": counter(
+                "ray_tpu_internal_reconstruction_attempts_total",
+                "Creating-task re-runs started to recover lost objects"),
+            "recon_successes": counter(
+                "ray_tpu_internal_reconstruction_successes_total",
+                "Reconstruction attempts whose returns re-sealed"),
+            "recon_failures": counter(
+                "ray_tpu_internal_reconstruction_failures_total",
+                "Reconstruction attempts whose returns errored"),
+            "recon_depth": hist(
+                "ray_tpu_internal_reconstruction_depth",
+                "Recursion depth at which reconstructions were started "
+                "(dependency chains re-run below the lost object)",
+                (1, 2, 4, 8)),
         }
         self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
         if isinstance(self.gcs, GcsClient):
@@ -3949,6 +4183,9 @@ class Raylet:
         bump(im["pull_sender_saturated"], "pull_sat",
              self._m_pull_sender_saturated)
         bump(im["locality_spills"], "loc_spills", self._m_locality_spills)
+        bump(im["recon_attempts"], "recon_att", self._m_recon_attempts)
+        bump(im["recon_successes"], "recon_ok", self._m_recon_successes)
+        bump(im["recon_failures"], "recon_fail", self._m_recon_failures)
         if self._pull_manager is not None:
             ps = self._pull_manager.stats()
             im["pull_inflight_bytes"].set(ps["inflight_bytes"])
@@ -3963,14 +4200,16 @@ class Raylet:
 
         import json as _json
 
+        items = []
         for m in im.values():
             payload = m._export()
             if payload is None:
                 continue
-            self._gcs_post(
-                "kv_put", "metrics",
-                f"{self._im_producer}/{m.name}".encode(),
-                _json.dumps(payload).encode())
+            items.append((f"{self._im_producer}/{m.name}".encode(),
+                          _json.dumps(payload).encode()))
+        if items:
+            # one post for the whole metric set (~30 keys), not one per key
+            self._gcs_post("kv_multi_put", "metrics", items)
 
     def state_snapshot(self, objects_limit: int = 0) -> dict:
         return {

@@ -57,12 +57,20 @@ _LANE = 128  # minor-dim block width Pallas TPU requires
 
 # Both grid dims are embarrassingly parallel (batch*heads, and q/k blocks
 # within a head); telling Mosaic so lets it pipeline block prologues across
-# steps instead of treating the grid as a dependent loop nest.
+# steps instead of treating the grid as a dependent loop nest.  The params
+# class moved across jax releases (TPUCompilerParams -> CompilerParams);
+# resolve whichever this install has, and degrade to None (valid for
+# pallas_call) when neither exists — interpret-mode tests don't need it.
+_COMPILER_PARAMS = None
 if _HAS_PLTPU:
-    _COMPILER_PARAMS = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel"))
-else:  # pragma: no cover
-    _COMPILER_PARAMS = None
+    _params_cls = (getattr(pltpu, "CompilerParams", None)
+                   or getattr(pltpu, "TPUCompilerParams", None))
+    if _params_cls is not None:
+        try:
+            _COMPILER_PARAMS = _params_cls(
+                dimension_semantics=("parallel", "parallel"))
+        except TypeError:  # pragma: no cover — surface drift
+            _COMPILER_PARAMS = None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,3 @@
+# jax.shard_map exists on every supported jax once the compat shim loads
+# (older releases only have jax.experimental.shard_map).
+from ray_tpu.parallel import _shard_map_compat  # noqa: F401

@@ -1,0 +1,34 @@
+"""``jax.shard_map`` compatibility across jax releases.
+
+Newer jax exports ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+axis_names=..., check_vma=...)`` at top level; older releases only ship
+``jax.experimental.shard_map.shard_map(f, mesh, in_specs, out_specs,
+check_rep=..., auto=...)``.  The tensor-plane code (and its tests) use the
+modern spelling; when this install predates it, install an adapter at
+``jax.shard_map`` that translates:
+
+  * ``check_vma``   -> ``check_rep`` (same meaning: replication checking)
+  * ``axis_names``  -> ``auto`` (the complement: axes NOT listed stay
+                       automatic/sharded-by-the-compiler)
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):  # pragma: no branch — version gate
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                   axis_names=None, check_vma=None, check_rep=None, **kw):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        if axis_names is not None and mesh is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep,
+                                 **kw)
+
+    jax.shard_map = _shard_map
+
+shard_map = jax.shard_map

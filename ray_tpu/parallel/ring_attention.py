@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ray_tpu.parallel._shard_map_compat import shard_map
 
 from ray_tpu.ops.flash_attention import (
     _flash_bwd,

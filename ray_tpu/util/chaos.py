@@ -2,21 +2,49 @@
 
 Reference analogue: `python/ray/_private/test_utils.py:1400`
 (NodeKillerActor / ResourceKillerActor, ``kill_raylet :1741``) and
-`python/ray/tests/test_chaos.py`.  Works against the fake in-machine
-cluster (`ray_tpu/cluster_utils.py`): periodically SIGKILLs a random
-worker NODE (never the head) while a workload runs, so retries, actor
-failover, and lineage reconstruction are exercised under real process
-death.
+`python/ray/tests/test_chaos.py`.  Two tools:
+
+  * ``NodeKiller`` — periodically SIGKILLs a random worker NODE of the
+    fake in-machine cluster (never the head), optionally respawning a
+    replacement, so retries, actor failover, and lineage reconstruction
+    are exercised under real process death.
+
+  * ``NetworkChaos`` — deterministic, seedable network-fault injection on
+    the runtime's own sockets: frame drop / delay / blackhole on raylet
+    PEER connections and on the zero-copy DATA channels.  Env-gated via
+    ``RAY_TPU_CHAOS_*`` so spawned raylet processes pick it up, or
+    configured programmatically with :func:`configure_net` for the
+    in-process raylet.  The send/serve hot paths call :func:`net_fault`,
+    which is a no-op attribute check when chaos is disabled.
+
+    Env knobs (all probabilities in [0,1]):
+      RAY_TPU_CHAOS_NET_SEED         deterministic RNG seed (default 0)
+      RAY_TPU_CHAOS_NET_DROP_P       drop a frame/response entirely
+      RAY_TPU_CHAOS_NET_DELAY_P      delay a frame before sending
+      RAY_TPU_CHAOS_NET_DELAY_MS     the injected delay, milliseconds
+      RAY_TPU_CHAOS_NET_BLACKHOLE_P  partition the connection: every
+                                     later frame on it vanishes silently
+      RAY_TPU_CHAOS_NET_CHANNELS     csv of channels to afflict
+                                     ("peer", "data"; default "data" —
+                                     peer control frames have no
+                                     per-frame retry, so dropping them
+                                     is an explicit opt-in)
+
+    A fault decision sequence is fully determined by (seed, sequence of
+    ``net_fault`` calls), so a single-threaded workload replays exactly;
+    multi-threaded callers still get a reproducible fault MIX.
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 from typing import List, Optional
 
-__all__ = ["NodeKiller"]
+__all__ = ["NodeKiller", "NetworkChaos", "net_fault", "configure_net",
+           "net"]
 
 
 class NodeKiller:
@@ -68,3 +96,111 @@ class NodeKiller:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Network fault injection
+
+
+class NetworkChaos:
+    """Seedable fault decisions for the runtime's sockets.  One instance
+    per process; decisions are drawn from a private ``random.Random`` so a
+    fixed seed gives a reproducible fault sequence."""
+
+    __slots__ = ("enabled", "seed", "drop_p", "delay_p", "delay_s",
+                 "blackhole_p", "channels", "_rng", "_lock", "faults")
+
+    def __init__(self, drop_p: float = 0.0, delay_p: float = 0.0,
+                 delay_ms: float = 0.0, blackhole_p: float = 0.0,
+                 seed: int = 0, channels: Optional[List[str]] = None):
+        self.drop_p = max(0.0, drop_p)
+        self.delay_p = max(0.0, delay_p)
+        self.delay_s = max(0.0, delay_ms) / 1e3
+        self.blackhole_p = max(0.0, blackhole_p)
+        # Default to the DATA channel only: the pull manager's watchdogs
+        # retry/rotate lost data frames, but peer control frames (xtask,
+        # xdone, pull) are fire-and-forget over TCP — the runtime has no
+        # per-frame ack, so dropping them simulates a failure mode the
+        # real transport cannot produce and recovery is not defined for.
+        # Afflicting "peer" is an explicit opt-in (delay is safe there;
+        # drop/blackhole model a partition the control plane does not
+        # currently heal).
+        self.channels = frozenset(channels or ("data",))
+        self.seed = seed
+        self.enabled = (self.drop_p > 0 or self.delay_p > 0
+                        or self.blackhole_p > 0)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # injected-fault counts by kind, for test assertions
+        self.faults = {"drop": 0, "delay": 0, "blackhole": 0}
+
+    @classmethod
+    def from_env(cls) -> "NetworkChaos":
+        env = os.environ
+
+        def f(name, default=0.0):
+            try:
+                return float(env.get(f"RAY_TPU_CHAOS_NET_{name}", default))
+            except ValueError:
+                return default
+
+        channels = [c.strip() for c in env.get(
+            "RAY_TPU_CHAOS_NET_CHANNELS", "data").split(",") if c.strip()]
+        return cls(drop_p=f("DROP_P"), delay_p=f("DELAY_P"),
+                   delay_ms=f("DELAY_MS"), blackhole_p=f("BLACKHOLE_P"),
+                   seed=int(f("SEED", 0)), channels=channels)
+
+    def decide(self, channel: str) -> Optional[str]:
+        """Draw a fault for one frame on ``channel``:
+        None | "drop" | "delay" | "blackhole"."""
+        if not self.enabled or channel not in self.channels:
+            return None
+        with self._lock:
+            r = self._rng.random()
+            if r < self.blackhole_p:
+                self.faults["blackhole"] += 1
+                return "blackhole"
+            r -= self.blackhole_p
+            if r < self.drop_p:
+                self.faults["drop"] += 1
+                return "drop"
+            r -= self.drop_p
+            if r < self.delay_p:
+                self.faults["delay"] += 1
+                return "delay"
+        return None
+
+
+_net: Optional[NetworkChaos] = None
+
+
+def net() -> NetworkChaos:
+    """The process's NetworkChaos instance (env-configured on first use)."""
+    global _net
+    if _net is None:
+        _net = NetworkChaos.from_env()
+    return _net
+
+
+def configure_net(**kwargs) -> NetworkChaos:
+    """Programmatic (re)configuration — for the in-process raylet in
+    tests.  Pass the NetworkChaos constructor kwargs; omit all to reset
+    from the environment."""
+    global _net
+    _net = NetworkChaos(**kwargs) if kwargs else NetworkChaos.from_env()
+    return _net
+
+
+def net_fault(channel: str) -> Optional[str]:
+    """Hot-path hook: a fault decision for one outbound frame, or None.
+    Costs one attribute check when chaos is disabled."""
+    n = _net
+    if n is None:
+        n = net()
+    if not n.enabled:
+        return None
+    fault = n.decide(channel)
+    if fault == "delay":
+        time.sleep(n.delay_s)
+        return None  # the frame still goes out, late
+    return fault

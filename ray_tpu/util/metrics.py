@@ -76,11 +76,16 @@ def _ensure_flusher():
 
 
 def flush_metrics():
-    """Push every registered metric's samples to the GCS KV now."""
+    """Push every registered metric's samples to the GCS KV now.  One
+    broken metric must not starve the rest of the registry — exports are
+    isolated per metric."""
     with _registry_lock:
         metrics = list(_registry)
     for m in metrics:
-        payload = m._export()
+        try:
+            payload = m._export()
+        except Exception:  # noqa: BLE001 — defensive: skip, don't starve
+            continue
         if payload is None:
             continue
         _kv_put(f"{_producer_id}/{m.name}".encode(),
@@ -112,7 +117,7 @@ def shutdown_metrics():
         metrics = list(_registry)
     for m in metrics:
         with m._lock:
-            m._values.clear()
+            getattr(m, "_values", {}).clear()
 
 
 def internal_metric(cls, name: str, *args, **kwargs):
@@ -226,10 +231,15 @@ class Histogram(Metric):
 
     def __init__(self, name, description: str = "",
                  boundaries: Optional[Sequence[float]] = None, tag_keys=None):
-        super().__init__(name, description, tag_keys)
+        # Validate BEFORE super().__init__: the base class registers the
+        # metric with the flusher, so raising after it would leave a
+        # half-constructed entry in the registry whose _export crashes
+        # every later flush (and silently starves the metrics registered
+        # after it — an ordering-dependent whole-suite failure).
         bounds = sorted(boundaries or (0.1, 1.0, 10.0, 100.0))
         if any(b <= 0 for b in bounds):
             raise ValueError("histogram boundaries must be positive")
+        super().__init__(name, description, tag_keys)
         self.boundaries = tuple(bounds)
         # key -> [bucket_counts..., +inf_count, sum, count]
         self._values: Dict[Tuple, list] = {}

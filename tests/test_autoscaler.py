@@ -51,6 +51,7 @@ def test_demand_scheduler_infeasible_shape_ignored():
 # ------------------------------------------------------------ cluster level
 
 
+@pytest.mark.slow
 def test_autoscaling_cluster_up_and_down():
     import ray_tpu
 
@@ -117,6 +118,7 @@ def test_autoscaler_min_workers_floor():
         cluster.shutdown()
 
 
+@pytest.mark.slow
 def test_up_down_cli(tmp_path):
     """`ray_tpu up cluster.yaml` / `down` (reference: `ray up/down`,
     `scripts.py:1238,1314`): head + autoscaler come up from YAML,

@@ -303,6 +303,7 @@ class TestNodeFailure:
             c.shutdown()
 
 
+@pytest.mark.slow
 def test_push_shuffle_bigger_than_store():
     """Distributed scatter/merge shuffle of a dataset LARGER than the
     object store: blocks spill to disk and the shuffle still completes

@@ -346,6 +346,7 @@ def test_groupby_aggregates(ray_shared):
     assert sums == {"a": 1 + 3 + 5 + 7 + 9, "b": 0 + 2 + 4 + 6 + 8}
 
 
+@pytest.mark.slow
 def test_iter_torch_batches(ray):
     """Torch-tensor batches off columnar blocks (reference:
     ``Dataset.iter_torch_batches``)."""

@@ -86,6 +86,7 @@ def _cartpole():
     return gymnasium.make("CartPole-v1")
 
 
+@pytest.mark.slow
 def test_dqn_smoke_and_checkpoint(ray):
     from ray_tpu.rllib import DQNConfig
 
@@ -112,6 +113,7 @@ def test_dqn_smoke_and_checkpoint(ray):
     algo2.stop()
 
 
+@pytest.mark.slow
 def test_dqn_learns_cartpole(ray):
     """DQN reaches >=150 mean reward on CartPole (reference:
     `rllib/algorithms/dqn/tests/test_dqn.py` learning bar — DQN is slower

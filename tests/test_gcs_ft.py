@@ -136,16 +136,19 @@ def test_restart_reconciler_buries_ghost_actors(tmp_path):
 
 def test_metrics_namespace_is_soft_state(tmp_path):
     """Metric flushes must not mark the durable snapshot dirty (they
-    previously rewrote it ~1/s forever) and stale producer keys TTL out."""
+    previously rewrote it ~1/s forever) and stale producer keys TTL out.
+
+    The dirty-flag check flushes SYNCHRONOUSLY instead of polling the
+    background flusher: under full-suite load the old 5s settle window
+    could expire with the flusher still behind, failing the assertion on
+    timing rather than semantics (the noted ordering flake)."""
     from ray_tpu.core.gcs import GcsCore
 
     path = str(tmp_path / "gcs.snap")
     g = GcsCore(persist_path=path)
-    g.kv_put("jobs", b"j1", b"info")       # durable
-    # wait for flusher to settle
-    deadline = time.monotonic() + 5
-    while g._dirty and time.monotonic() < deadline:
-        time.sleep(0.05)
+    g.kv_put("jobs", b"j1", b"info")       # durable -> marks dirty
+    g._write_snapshot()                    # deterministic flush
+    assert not g._dirty
     g.kv_put("metrics", b"pid-1/m", b"{}")  # soft
     assert not g._dirty, "metrics put must not dirty the snapshot"
     assert g.kv_get("metrics", b"pid-1/m") == b"{}"

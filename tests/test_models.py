@@ -21,6 +21,7 @@ def test_gpt2_forward_shapes():
     assert jnp.isfinite(logits).all()
 
 
+@pytest.mark.slow
 def test_gpt2_train_step_learns():
     cfg = gpt2.GPT2_TINY
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
@@ -81,6 +82,7 @@ def test_gpt2_ring_attention_matches_flash():
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=5e-2)
 
 
+@pytest.mark.slow
 def test_mnist_learns():
     params = mnist.init_params(jax.random.PRNGKey(0))
     opt = optax.adam(1e-3)
@@ -100,6 +102,7 @@ def test_mnist_learns():
     assert float(acc) > 0.5, float(acc)
 
 
+@pytest.mark.slow
 def test_llama_decode_matches_forward():
     cfg = llama.LLAMA_TINY
     params = llama.init_params(jax.random.PRNGKey(0), cfg)

@@ -18,6 +18,13 @@ from ray_tpu.parallel.sharding import ShardingConfig, shard_params
 
 TOL = 2e-2  # CPU backend matmuls are low-precision by default
 
+# Pipeline parallelism relies on the newer manual-sharding surface
+# (jax.lax.pcast / partial-auto shard_map); skip — not fail — on jax
+# releases that predate it (same policy as the pallas-surface guard).
+requires_pipeline_surface = pytest.mark.skipif(
+    not hasattr(jax.lax, "pcast"),
+    reason="pipeline parallelism needs jax.lax.pcast (newer jax)")
+
 
 def _qkv(B=2, H=4, S=128, D=32, dtype=jnp.float32):
     key = jax.random.PRNGKey(0)
@@ -245,6 +252,7 @@ def test_moe_ep_sharded_matches_single_device():
     assert abs(got - ref) < 1e-3, (got, ref)
 
 
+@requires_pipeline_surface
 def test_pipeline_matches_sequential():
     """pp=2 pipelined blocks produce the same loss as the sequential
     single-device model (the GPipe schedule only reorders work)."""
@@ -271,6 +279,7 @@ def test_pipeline_matches_sequential():
     assert abs(got - ref) < 1e-3, (got, ref)
 
 
+@requires_pipeline_surface
 def test_pipeline_moe_train_step_learns():
     """Full fwd+bwd+adamw on a pp x ep x tp mesh: grads flow through the
     ppermute schedule and the expert dispatch; loss decreases."""
@@ -356,6 +365,7 @@ def test_flash_attention_fused_bwd_mixed_dtypes():
     assert dv.dtype == jnp.bfloat16
 
 
+@requires_pipeline_surface
 def test_pipeline_moe_aux_collected_under_pp():
     """The MoE load-balancing aux must ride the pp stage handoff: the
     pp-pipelined loss equals the sequential loss WITH its aux term (to the
@@ -393,6 +403,7 @@ def test_pipeline_moe_aux_collected_under_pp():
     assert got > ref_no_aux + 1e-4
 
 
+@requires_pipeline_surface
 def test_pipeline_schedule_utilization():
     """The fill-drain schedule runs M+S-1 stage-body ticks per device with
     M useful — the best any non-interleaved schedule (GPipe or 1F1B)

@@ -51,6 +51,7 @@ def test_compute_gae_cuts_at_done():
     assert abs(adv[0, 0] - (1.0 - 0.5)) < 1e-6
 
 
+@pytest.mark.slow
 def test_ppo_single_iteration_shapes(ray):
     config = (PPOConfig()
               .environment(_cartpole)
@@ -69,6 +70,7 @@ def test_ppo_single_iteration_shapes(ray):
     algo.stop()
 
 
+@pytest.mark.slow
 def test_ppo_checkpoint_roundtrip(ray):
     config = (PPOConfig()
               .environment(_cartpole)
@@ -91,6 +93,7 @@ def test_ppo_checkpoint_roundtrip(ray):
     algo2.stop()
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole(ray):
     """The north-star learning test: CartPole-v1 to >=450 mean reward
     (reference: `rllib/algorithms/ppo/tests/test_ppo.py` learning tests;
@@ -157,6 +160,7 @@ def test_cnn_policy_shapes():
     assert value.shape == (2,)
 
 
+@pytest.mark.slow
 def test_impala_learns_cartpole(ray_shared):
     import gymnasium as gym
 
@@ -231,6 +235,7 @@ def _pendulum():
     return gymnasium.make("Pendulum-v1")
 
 
+@pytest.mark.slow
 def test_sac_learns_pendulum(ray):
     """SAC improves Pendulum substantially from the random baseline
     (~-1200 avg return) within a small env-step budget (reference:
@@ -298,6 +303,7 @@ class _SignalMatch:
         pass
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_learns(ray):
     """Per-policy batches through the multi-agent runner: two separate
     policies each learn to echo the observed bit (reference:
@@ -331,6 +337,7 @@ def test_multi_agent_ppo_learns(ray):
 # learner group
 
 
+@pytest.mark.slow
 def test_impala_learner_group_fanout(ray):
     """IMPALA with 2 data-parallel learner replicas: updates run, the
     replicas stay in lockstep (allreduced grads -> identical weights),

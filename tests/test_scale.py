@@ -16,6 +16,7 @@ def ray(ray_shared):
     return ray_shared
 
 
+@pytest.mark.slow
 def test_deep_queue_drain_rate_is_depth_independent(ray):
     """Drain throughput at 8x queue depth stays within noise of the
     shallow rate — a scheduler rescanning the whole queue per dispatch
@@ -53,6 +54,7 @@ def test_get_1k_distinct_objects(ray):
     assert int(out[777][0]) == 777
 
 
+@pytest.mark.slow
 def test_actor_fleet_roundtrip(ray):
     """A fleet of real actor processes all answer; calls fan out and
     return (bounded count — each actor is a process on this host)."""

@@ -2,10 +2,13 @@
 for the whole process — the embedded ray_shared fixture and a cluster
 attach cannot coexist)."""
 
+import pytest
+
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
+@pytest.mark.slow
 def test_eight_node_cluster_flood():
     """8 fake nodes: a 2k-task flood spills across every node and all
     results come home."""

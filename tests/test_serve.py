@@ -122,6 +122,7 @@ def test_multiple_replicas_share_load(served):
     serve.delete("slowid")
 
 
+@pytest.mark.slow
 def test_autoscaling_up_and_down(served):
     @serve.deployment(
         name="burst", num_replicas=1,
@@ -192,6 +193,7 @@ def test_redeploy_in_place(served):
     serve.delete("ver")
 
 
+@pytest.mark.slow
 def test_llama_generate_deployment(served):
     """The serving flagship: tiny-llama generate behind serve
     (BASELINE.json 'Ray Serve Llama-2-7B JAX inference deployment' shape,

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import ray_tpu
 from ray_tpu.util import state
 
@@ -44,6 +46,7 @@ def test_state_api_embedded(ray_shared):
     assert objs["total"] >= 5
 
 
+@pytest.mark.slow
 def test_cli_status_and_list_on_cluster():
     from ray_tpu.cluster_utils import Cluster
 
@@ -68,6 +71,7 @@ def test_cli_status_and_list_on_cluster():
         assert row["state"] == "ALIVE"
 
 
+@pytest.mark.slow
 def test_cli_serve_deploy_status_and_memory(tmp_path):
     """serve deploy/status + memory CLI subcommands (reference: `serve
     deploy` CLI + `ray memory`)."""

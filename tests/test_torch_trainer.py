@@ -50,6 +50,7 @@ def _torch_loop(config):
         train.report({"loss": total, "world_size": world})
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp_two_workers(ray, tmp_path):
     trainer = TorchTrainer(
         _torch_loop,
@@ -77,6 +78,7 @@ def test_prepare_helpers_no_process_group():
     assert prepare_data_loader(dl) is dl
 
 
+@pytest.mark.slow
 def test_sklearn_trainer(ray, tmp_path):
     """SklearnTrainer fits an estimator on Dataset rows and checkpoints it
     (reference: `python/ray/train/sklearn/sklearn_trainer.py`)."""

@@ -143,6 +143,7 @@ def _jax_cfg():
     return JaxConfig(platform="cpu", devices_per_worker=4)
 
 
+@pytest.mark.slow
 def test_global_mesh_bootstrap(ray_train, tmp_path):
     """2 worker processes form one 8-device mesh; collectives cross."""
     from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
@@ -162,6 +163,7 @@ def test_global_mesh_bootstrap(ray_train, tmp_path):
     assert result.metrics["sum"] == 96.0
 
 
+@pytest.mark.slow
 def test_mnist_dp_two_workers(ray_train, tmp_path):
     from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
 
@@ -183,6 +185,7 @@ def test_mnist_dp_two_workers(ray_train, tmp_path):
     assert result.metrics_history[-1]["loss"] < result.metrics_history[0]["loss"]
 
 
+@pytest.mark.slow
 def test_gpt2_sharded_two_workers(ray_train, tmp_path):
     from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
 

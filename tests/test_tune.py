@@ -80,6 +80,7 @@ def test_trainable_error_is_captured(ray, tmp_path):
     assert len(grid.errors) == 2
 
 
+@pytest.mark.slow
 def test_asha_stops_bad_trials_early(ray, tmp_path):
     """Bad trials (low asymptote) must be stopped before max_t while the
     best trial runs to completion."""
@@ -115,6 +116,7 @@ def test_asha_stops_bad_trials_early(ray, tmp_path):
     assert grid.get_best_result().config["cap"] == 8
 
 
+@pytest.mark.slow
 def test_pbt_perturbs_and_exploits(ray, tmp_path):
     """8 trials; only high-lr trials improve. PBT must clone winners into
     losers (checkpoint exploit) and perturb lr."""
@@ -182,6 +184,7 @@ def test_experiment_state_and_restore(ray, tmp_path):
     assert grid2.get_best_result("score", "max").metrics["score"] == 10
 
 
+@pytest.mark.slow
 def test_tuner_runs_jax_trainer(ray, tmp_path):
     """Train-under-Tune: JaxTrainer.as_trainable() through the Tuner."""
     import numpy as np
@@ -279,6 +282,7 @@ def test_class_trainable_iteration_survives_restart(ray, tmp_path):
     assert r.metrics["score"] == 5
 
 
+@pytest.mark.slow
 def test_tpe_searcher_improves_over_random(ray, tmp_path):
     """TPESearcher (reference: the hyperopt/BOHB model family in
     `tune/search/`): later suggestions concentrate near the optimum of a
@@ -306,6 +310,7 @@ def test_tpe_searcher_improves_over_random(ray, tmp_path):
     assert grid.get_best_result().metrics["score"] > -1.0
 
 
+@pytest.mark.slow
 def test_basic_variant_searcher(ray, tmp_path):
     def objective(config):
         tune.report({"score": config["x"]})
@@ -322,6 +327,7 @@ def test_basic_variant_searcher(ray, tmp_path):
     assert all(0 <= r.config["x"] <= 1 for r in grid)
 
 
+@pytest.mark.slow
 def test_median_stopping_rule(ray, tmp_path):
     """Bad trials stop early; good ones run to completion (reference:
     `tune/schedulers/median_stopping_rule.py`)."""
@@ -349,6 +355,7 @@ def test_median_stopping_rule(ray, tmp_path):
     assert max(high) == 12
 
 
+@pytest.mark.slow
 def test_uri_storage_sync_and_restore(ray, tmp_path):
     """A file:// storage_path mirrors the experiment dir through the
     Syncer (reference: `tune/syncer.py:24-115`), and Tuner.restore(uri)
@@ -407,6 +414,7 @@ class _HillClimbOptimizer:
         self._seen.append((score, dict(config)))
 
 
+@pytest.mark.slow
 def test_ask_tell_searcher_beats_random(ray, tmp_path):
     """The ask/tell adapter (reference: optuna_search.py integration
     seam) feeds results back into the optimizer; on a seeded quadratic

@@ -44,6 +44,7 @@ def _record_and_double(x, touch_path=None):
 # ------------------------------------------------------------------- DAG
 
 
+@pytest.mark.slow
 def test_dag_bind_execute(ray):
     dag = _add.bind(_mul.bind(2, 3), _mul.bind(4, 5))
     assert ray_tpu.get(dag.execute()) == 26
