@@ -82,9 +82,7 @@ def init(
     if address is None:
         # Auto-attach for entrypoints launched by the job manager
         # (reference: RAY_ADDRESS handling in ray.init).
-        import os as _os
-
-        address = _os.environ.get("RAY_TPU_ADDRESS") or None
+        address = config.address or None
     if address is not None:
         from ray_tpu.core.client import ClientWorker
 

@@ -10,7 +10,7 @@ actor scheduling queues (`src/ray/core_worker/transport/actor_scheduling_queue.c
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, TaskID

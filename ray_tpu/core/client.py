@@ -20,6 +20,7 @@ from ray_tpu.core import protocol
 from ray_tpu.core.gcs import GcsClient
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.worker import Worker
+from ray_tpu.util.locks import make_lock
 
 
 class ClientWorker(Worker):
@@ -46,9 +47,9 @@ class ClientWorker(Worker):
         self.sock = socket.create_connection((host, port), timeout=10)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.send_lock = threading.Lock()
-        self._rid = 0
-        self._rid_lock = threading.Lock()
+        self.send_lock = make_lock("client.send")
+        self._rid = 0  # guard: _rid_lock
+        self._rid_lock = make_lock("client.rid")
         self._pending: Dict[int, dict] = {}
         self._hello = threading.Event()
         self._hello_msg: Optional[dict] = None

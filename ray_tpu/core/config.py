@@ -7,6 +7,24 @@ be overridden by ``RAY_TPU_<NAME>`` in the environment.  Flags are read at
 process start; ``Config.initialize(overrides)`` applies a dict (the launcher
 serializes driver-side overrides into worker processes this way, like the
 reference serializes its config JSON into every raylet/worker command line).
+
+This registry is the ONLY sanctioned reader of ``RAY_TPU_*`` environment
+variables: every knob and per-process identity variable is declared here (or
+in its owning module via ``config.define``), and the static-analysis suite
+(`tools/analysis`, env-flag-registry pass) rejects direct ``os.environ``
+reads of ``RAY_TPU_*`` anywhere else in the package.  The same declarations
+generate the env-var reference table in the README
+(``python -m tools.analysis --write-env-table``).
+
+Two flavors of flag:
+
+* plain (default): the environment is read ONCE, at ``define()`` time
+  (process start) — the reference's read-at-startup semantics.
+* ``live=True``: attribute access re-reads the environment on every read.
+  Used for per-process identity variables that a parent sets in a child's
+  environment (node id, worker profile, session dir) and for test-facing
+  knobs flipped via ``monkeypatch.setenv`` after import (chaos injection,
+  debug locks).
 """
 
 from __future__ import annotations
@@ -31,34 +49,75 @@ _PARSERS: Dict[type, Callable[[str], Any]] = {
 
 
 class _Flag:
-    __slots__ = ("name", "type", "default", "doc", "value")
+    __slots__ = ("name", "type", "default", "doc", "value", "live")
 
-    def __init__(self, name, type_, default, doc):
+    def __init__(self, name, type_, default, doc, live=False):
         self.name = name
         self.type = type_
         self.default = default
         self.doc = doc
-        env = os.environ.get(_ENV_PREFIX + name.upper())
+        self.live = live
+        self.value = default
+        if not live:
+            self.reload()
+
+    @property
+    def env_name(self) -> str:
+        return _ENV_PREFIX + self.name.upper()
+
+    def _parse(self, raw: str):
+        # A malformed env value falls back to the current value instead of
+        # blowing up whichever import happens to define the flag.
+        try:
+            return _PARSERS[self.type](raw)
+        except (ValueError, TypeError):
+            return self.value
+
+    def reload(self):
+        """Recompute the stored value: default, then environment override
+        (so deleting the env var between reloads restores the default).
+        Live flags re-read the environment on every access and never bake
+        it into the stored value — reload is a no-op for them."""
+        if self.live:
+            return
+        self.value = self.default
+        env = os.environ.get(self.env_name)
         if env is not None:
-            self.value = _PARSERS[type_](env)
-        else:
-            self.value = default
+            self.value = self._parse(env)
+
+    def current(self):
+        if self.live:
+            env = os.environ.get(self.env_name)
+            if env is not None:
+                return self._parse(env)
+        return self.value
 
 
 class _Config:
     def __init__(self):
         self._flags: Dict[str, _Flag] = {}
 
-    def define(self, name: str, type_: type, default, doc: str = ""):
-        self._flags[name] = _Flag(name, type_, default, doc)
+    def define(self, name: str, type_: type, default, doc: str = "",
+               live: bool = False):
+        self._flags[name] = _Flag(name, type_, default, doc, live=live)
 
     def initialize(self, overrides: Dict[str, Any]):
         for k, v in overrides.items():
             if k in self._flags:
                 self._flags[k].value = self._flags[k].type(v)
 
+    def reload(self, *names: str):
+        """Re-read environment overrides — all flags, or just ``names``.
+        Lets tests (and ``chaos.configure_net``) apply ``setenv`` changes
+        made after the defining module was imported."""
+        for name in names or list(self._flags):
+            self._flags[name].reload()
+
     def to_dict(self) -> Dict[str, Any]:
-        return {k: f.value for k, f in self._flags.items()}
+        # Live flags are per-process identity (node id, session dir, ...):
+        # serializing a driver's identity into a worker would be wrong, so
+        # they never ride the override dict.
+        return {k: f.value for k, f in self._flags.items() if not f.live}
 
     def serialize(self) -> str:
         return json.dumps(self.to_dict())
@@ -66,7 +125,7 @@ class _Config:
     def __getattr__(self, name: str):
         flags = object.__getattribute__(self, "_flags")
         if name in flags:
-            return flags[name].value
+            return flags[name].current()
         raise AttributeError(name)
 
     def __setattr__(self, name, value):
@@ -153,3 +212,60 @@ config.define("internal_metrics_interval_s", float, 1.0,
 config.define("mesh_default_axes", str, "dp,tp", "")
 config.define("enable_pallas", bool, True,
               "Use Pallas kernels on TPU when shapes allow.")
+
+# --- process identity (live: set by a parent in the child's environment) ----
+config.define("address", str, "",
+              "Cluster address auto-attached by ray_tpu.init() when no "
+              "address argument is given (reference: RAY_ADDRESS); set by "
+              "the job manager for submitted entrypoints.", live=True)
+config.define("node_id", str, "",
+              "Hosting raylet's node id, set in every spawned worker's "
+              "environment (runtime_context.get_node_id on workers).",
+              live=True)
+config.define("job_id", str, "driver",
+              "Job attribution for task events: the job supervisor sets "
+              "this in the entrypoint's environment before the driver "
+              "starts (read once at import); ad-hoc drivers share one "
+              "'driver' bucket.")
+config.define("session_dir", str, "",
+              "Session directory, set in spawned workers' environment by "
+              "their raylet (log files, runtime-env staging).", live=True)
+config.define("worker_profile", str, "cpu",
+              "Worker-pool profile this worker process was spawned for "
+              "(set by the raylet; read back at register time).", live=True)
+config.define("worker_id", str, "",
+              "TPU worker index within a pod slice (topology label "
+              "tpu_worker_id; TPU_WORKER_ID is the non-test source).",
+              live=True)
+config.define("actor_restarts", int, 0,
+              "Restart count the raylet stamps into a restarted actor "
+              "worker's environment (was_current_actor_reconstructed).",
+              live=True)
+config.define("num_chips", int, 0,
+              "TPU chip count to advertise as this node's TPU resource "
+              "(overrides jax device discovery).", live=True)
+config.define("gcs_address", str, "",
+              "GCS host:port for autoscaler-provisioned nodes: the "
+              "instance startup script exports it and hands it to "
+              "`ray_tpu start`.", live=True)
+config.define("node_type", str, "",
+              "Autoscaler node-type name of a provisioned instance "
+              "(exported by its startup script).", live=True)
+config.define("accelerator_type", str, "",
+              "Accelerator type topology label (e.g. v5e-8); test "
+              "override for TPU_ACCELERATOR_TYPE.", live=True)
+config.define("slice_id", str, "",
+              "Pod-slice identity topology label (tpu_slice): nodes "
+              "sharing it are ICI-adjacent; test override for TPU_NAME.",
+              live=True)
+config.define("topology", str, "",
+              "Slice topology label (e.g. 2x4); test override for "
+              "TPU_TOPOLOGY.", live=True)
+
+# --- developer tooling ------------------------------------------------------
+config.define("debug_locks", bool, False,
+              "Runtime lock-order watchdog: util.locks.make_lock() returns "
+              "DebugLock wrappers that record per-thread lock acquisition "
+              "order into a global graph and report potential-deadlock "
+              "cycles with the stacks of both orderings.  On for the test "
+              "suite in CI.", live=True)

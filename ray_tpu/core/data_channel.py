@@ -42,6 +42,7 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.protocol import recv_exact as _recv_exact
 from ray_tpu.core.protocol import recv_into_exact
 from ray_tpu.util import chaos as _chaos
+from ray_tpu.util.locks import make_lock
 
 MAGIC = b"RTDP\x01\x00\x00\x00"
 
@@ -90,8 +91,8 @@ class DataServer:
         self._store_fn = store_fn
         self._listener = socket.create_server((node_ip, 0), backlog=32)
         self.port = self._listener.getsockname()[1]
-        self._conns: Dict[int, socket.socket] = {}
-        self._lock = threading.Lock()
+        self._conns: Dict[int, socket.socket] = {}  # guard: _lock
+        self._lock = make_lock("data_server.conns")
         self._closed = False
         # Test seam: per-READ artificial delay (lets tests kill a holder
         # deterministically "mid-stream").
@@ -302,9 +303,9 @@ class DataChannel:
         except OSError:
             pass
         self._sock.sendall(MAGIC)
-        self._send_lock = threading.Lock()
-        self._sinks: Dict[int, memoryview] = {}
-        self._sinks_lock = threading.Lock()
+        self._send_lock = make_lock("data_channel.send")
+        self._sinks: Dict[int, memoryview] = {}  # guard: _sinks_lock
+        self._sinks_lock = make_lock("data_channel.sinks")
         self._chaos_blackholed = False
         self.alive = True
         self._recv_thread = threading.Thread(
@@ -350,6 +351,9 @@ class DataChannel:
             return True
         try:
             with self._send_lock:
+                # blocking-ok: the send lock EXISTS to serialize writers on
+                # this socket; requests are tiny (37B) and the receiver
+                # drains continuously, so the buffer can't stay full.
                 self._sock.sendall(data)
             return True
         except OSError:

@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol
 from ray_tpu.core.config import config
+from ray_tpu.util.locks import make_lock, make_rlock
 
 config.define("gcs_heartbeat_interval_s", float, 0.25,
               "Raylet -> GCS resource heartbeat period.")
@@ -65,46 +66,46 @@ class GcsCore:
     protocol, `test_gcs_fault_tolerance.py`)."""
 
     def __init__(self, persist_path: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("gcs.core")
         self._persist_path = persist_path
-        self._dirty = False
-        self._flush_lock = threading.Lock()
+        self._dirty = False  # guard: _lock
+        self._flush_lock = make_lock("gcs.snapshot")
         # node_id(hex) -> {address:(host,port)|None, resources_total,
         #                  resources_available, store_path, alive,
         #                  last_heartbeat, hostname}
-        self._nodes: Dict[str, dict] = {}
-        self._kv: Dict[Tuple[str, bytes], bytes] = {}
-        self._functions: Dict[bytes, bytes] = {}
+        self._nodes: Dict[str, dict] = {}  # guard: _lock
+        self._kv: Dict[Tuple[str, bytes], bytes] = {}  # guard: _lock
+        self._functions: Dict[bytes, bytes] = {}  # guard: _lock
         # actor_id(bytes) -> {owner_node, state, name, namespace, spec_blob}
-        self._actors: Dict[bytes, dict] = {}
-        self._named: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
+        self._actors: Dict[bytes, dict] = {}  # guard: _lock
+        self._named: Dict[Tuple[str, str], bytes] = {}  # guard: _lock
         # cluster placement groups: pg_id -> {bundles, strategy,
         #   assignments: {bundle_idx: node_id}, origin, pending, state}
-        self._cluster_pgs: Dict[str, dict] = {}
+        self._cluster_pgs: Dict[str, dict] = {}  # guard: _lock
         # Task-event table (reference: the GCS task-event backend behind
         # `list_tasks`/`ray.timeline`, `python/ray/util/state/api.py:1009`):
         # job_id -> {"events": deque (raw log, timeline), "tasks": dict
         # task_id(hex) -> latest event (state API)}.  Bounded per job
         # (config.task_events_max_per_job), soft state — never persisted.
-        self._task_events: Dict[str, dict] = {}
-        self._task_events_dropped = 0  # raylet-side ring-buffer drops
+        self._task_events: Dict[str, dict] = {}  # guard: _lock
+        self._task_events_dropped = 0  # guard: _lock
         # oid(hex) -> {nodes: set[node_id], size, inline}
-        self._objects: Dict[str, dict] = {}
+        self._objects: Dict[str, dict] = {}  # guard: _lock
         # oid(hex) -> set of watcher node_ids (want a push when located)
-        self._object_watchers: Dict[str, set] = {}
+        self._object_watchers: Dict[str, set] = {}  # guard: _lock
         # subscribers: (node_id_or_None, callback(event, data))
-        self._subs: List[Tuple[Optional[str], Callable[[str, Any], None]]] = []
+        self._subs: List[Tuple[Optional[str], Callable[[str, Any], None]]] = []  # guard: _lock
         self._monitor: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._restored = False  # snapshot loaded => this is a restart
-        self._kv_soft_ts: Dict[Tuple[str, bytes], float] = {}
+        self._kv_soft_ts: Dict[Tuple[str, bytes], float] = {}  # guard: _lock
         if persist_path:
             self._load_snapshot()
             self._start_flusher()
 
     # ------------------------------------------------------- persistence
 
-    def _mark_dirty(self):
+    def _mark_dirty(self):  # requires: _lock
         if self._persist_path:
             self._dirty = True
 
@@ -177,11 +178,13 @@ class GcsCore:
     def _start_flusher(self):
         def loop():
             while not self._stop.wait(0.1):
-                if self._dirty:
+                if self._dirty:  # unguarded-ok: racy flag read; rechecked under _flush_lock/_lock in _write_snapshot
                     try:
                         self._write_snapshot()
                     except Exception:  # noqa: BLE001 — flusher must live
                         traceback.print_exc()
+            # unguarded-ok: shutdown-path flag read; a lost race means one
+            # extra (idempotent) snapshot or a flush the NEXT start replays
             if self._dirty:  # final flush on shutdown
                 try:
                     self._write_snapshot()
@@ -464,7 +467,7 @@ class GcsCore:
         self._stop.set()
         # Synchronous final flush: a graceful shutdown must not lose
         # acknowledged durable mutations to the async-flusher window.
-        if self._persist_path and self._dirty:
+        if self._persist_path and self._dirty:  # unguarded-ok: shutdown-path flag read
             try:
                 self._write_snapshot()
             except OSError:
@@ -536,7 +539,7 @@ class GcsCore:
                 "pending": set(assignments.values()),
                 "state": "reserving",
             }
-        self._mark_dirty()
+            self._mark_dirty()
         for node in set(assignments.values()):
             sub = {i: bundles[i] for i, n in assignments.items()
                    if n == node}
@@ -882,7 +885,7 @@ class GcsCore:
                 if len(tasks) > cap:
                     tasks.pop(next(iter(tasks)))
 
-    def _job_slots(self, job_id: Optional[str]) -> List[dict]:
+    def _job_slots(self, job_id: Optional[str]) -> List[dict]:  # requires: _lock
         if job_id is not None:
             slot = self._task_events.get(job_id)
             return [slot] if slot else []
@@ -1003,10 +1006,10 @@ class GcsServer:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.append(sock)
             threading.Thread(target=self._serve_conn, args=(sock,),
-                             daemon=True).start()
+                             name="gcs-serve", daemon=True).start()
 
     def _serve_conn(self, sock: socket.socket):
-        send_lock = threading.Lock()
+        send_lock = make_lock("gcs.server_conn.send")
         push_cb = None
         reader = protocol.FrameReader(sock)
         try:
@@ -1085,9 +1088,9 @@ class GcsClient:
                                               timeout=timeout)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
-        self._rid = 0
-        self._rid_lock = threading.Lock()
+        self._send_lock = make_lock("gcs_client.send")
+        self._rid = 0  # guard: _rid_lock
+        self._rid_lock = make_lock("gcs_client.rid")
         self._pending: Dict[int, dict] = {}
         self._push_handler = push_handler
         self._on_disconnect = on_disconnect

@@ -93,7 +93,8 @@ class ObjectRef:
 
         import threading
 
-        threading.Thread(target=_resolve, daemon=True).start()
+        threading.Thread(target=_resolve, name="objref-resolve",
+                         daemon=True).start()
         return fut
 
     def __await__(self):

@@ -28,6 +28,7 @@ from typing import Any, Optional
 from ray_tpu.core import serialization
 from ray_tpu.core.config import config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.util.locks import make_lock
 
 
 from ray_tpu.core.exceptions import ObjectLostError as _BaseObjectLostError
@@ -165,8 +166,8 @@ class ShmObjectStore:
         # Serializes close() against native calls from data-plane threads
         # (serve/receive): a check-then-act on _handle alone could pass a
         # NULL/freed handle into C during raylet shutdown.
-        self._close_lock = threading.Lock()
-        self._handle = self._lib.rt_store_attach(path.encode())
+        self._close_lock = make_lock("object_store.close")
+        self._handle = self._lib.rt_store_attach(path.encode())  # guard: _close_lock
         if not self._handle:
             raise OSError(f"cannot attach to object store at {path}")
         fd = os.open(path, os.O_RDWR)
@@ -286,7 +287,9 @@ class ShmObjectStore:
                                               object_id.binary()))
 
     def delete(self, object_id: ObjectID) -> bool:
-        ok = self._lib.rt_delete(self._handle, object_id.binary()) == 0
+        with self._close_lock:
+            ok = bool(self._handle) and \
+                self._lib.rt_delete(self._handle, object_id.binary()) == 0
         try:
             os.unlink(self._spill_path(object_id))
             ok = True
@@ -296,7 +299,9 @@ class ShmObjectStore:
 
     def stats(self) -> dict:
         st = _StoreStats()
-        self._lib.rt_stats(self._handle, ctypes.byref(st))
+        with self._close_lock:
+            if self._handle:
+                self._lib.rt_stats(self._handle, ctypes.byref(st))
         return {
             "capacity": st.capacity,
             "bytes_in_use": st.bytes_in_use,

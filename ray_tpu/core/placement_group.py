@@ -13,7 +13,6 @@ analogue of NVLink-island STRICT_PACK — see SURVEY.md §7).
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 from ray_tpu.core.ids import ObjectID, PlacementGroupID, put_counter

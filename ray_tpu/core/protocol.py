@@ -39,11 +39,17 @@ equivalent cost in GIL-released Cython (`_raylet.pyx:3111`).
 
 from __future__ import annotations
 
-import os
 import pickle
 import socket
 import struct
 from typing import Any, Callable, List, Optional, Tuple
+
+from ray_tpu.core.config import config
+
+config.define("disable_native_codec", bool, False,
+              "Force the pure-Python frame codec even when the native "
+              "library is available (parity tests, debugging).  Consumed "
+              "once, when the codec singleton is built at import.")
 
 _LEN = struct.Struct("<Q")
 _HDR = _LEN.size
@@ -188,8 +194,7 @@ class NativeCodec:
 
 
 def _select_codec():
-    if os.environ.get("RAY_TPU_DISABLE_NATIVE_CODEC", "").strip() in (
-            "1", "true", "yes", "on"):
+    if config.disable_native_codec:
         return PythonCodec()
     from ray_tpu.native.build import try_lib_path
 
@@ -215,6 +220,9 @@ def send_msg(sock: socket.socket, msg: Any, lock=None):
     frame = _LEN.pack(len(data)) + data
     if lock is not None:
         with lock:
+            # blocking-ok: the caller-passed lock exists to serialize
+            # writers on this one socket (frame integrity); it guards no
+            # other state, so nothing else can queue behind the send.
             sock.sendall(frame)
     else:
         sock.sendall(frame)
@@ -236,6 +244,8 @@ def send_msgs(sock: socket.socket, msgs, lock=None):
     frame = _codec.encode(payloads)
     if lock is not None:
         with lock:
+            # blocking-ok: per-socket write-serialization lock (see
+            # send_msg above); guards no other state.
             sock.sendall(frame)
     else:
         sock.sendall(frame)

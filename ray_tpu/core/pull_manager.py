@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ray_tpu.core.config import config
 from ray_tpu.core.data_channel import DataChannel
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.util.locks import make_lock
 from ray_tpu.util.retry import BackoffPolicy
 
 config.define("data_dial_attempts", int, 3,
@@ -102,20 +103,22 @@ class PullManager:
         self._post = post
         self._on_done = on_done
         self._on_fail = on_fail
-        self._lock = threading.Lock()
+        self._lock = make_lock("pull_manager.state")
         self._rid = itertools.count(1)
         self._seq = itertools.count()
-        self._pulls: Dict[ObjectID, _Pull] = {}      # admitted (meta/active)
-        self._queue: list = []                       # heap of admission waits
-        self._queued: Dict[ObjectID, _Pull] = {}
-        self._rid_to_pull: Dict[int, _Pull] = {}
-        self._channels: Dict[str, DataChannel] = {}
-        self._inflight_bytes = 0
+        self._pulls: Dict[ObjectID, _Pull] = {}      # guard: _lock
+        self._queue: list = []                       # guard: _lock
+        self._queued: Dict[ObjectID, _Pull] = {}     # guard: _lock
+        self._rid_to_pull: Dict[int, _Pull] = {}     # guard: _lock
+        self._channels: Dict[str, DataChannel] = {}  # guard: _lock
+        self._inflight_bytes = 0                     # guard: _lock
         self._closed = False
         # Nodes with no dialable data channel (dial failed / no data_port):
         # node_id -> tombstone expiry.  Lets request() refuse synchronously
         # so the caller falls back to the control-plane path instead of
-        # re-dialing a dead host on every retry.
+        # re-dialing a dead host on every retry.  Event thread (request) +
+        # dialer thread (_dial) both touch it; entries are independent and
+        # dict get/set/del are GIL-atomic, so it rides without the lock.
         self._no_data_plane: Dict[str, float] = {}
         # Blocking TCP dials run on a dedicated dialer thread — NEVER on
         # the raylet event thread (a blackholed holder would stall
@@ -124,13 +127,13 @@ class PullManager:
         self._dial_q: _queue.SimpleQueue = _queue.SimpleQueue()
         self._dialer_started = False
         # ---- cumulative stats (read by metrics flush + tests) ----
-        self._bytes_total = 0
-        self._chunks_total = 0
-        self._source_switches = 0
-        self._multi_source_pulls = 0
-        self._completed = 0
-        self._failed = 0
-        self._last_completed: Optional[dict] = None
+        self._bytes_total = 0                        # guard: _lock
+        self._chunks_total = 0                       # guard: _lock
+        self._source_switches = 0                    # guard: _lock
+        self._multi_source_pulls = 0                 # guard: _lock
+        self._completed = 0                          # guard: _lock
+        self._failed = 0                             # guard: _lock
+        self._last_completed: Optional[dict] = None  # guard: _lock
 
     # ------------------------------------------------------------- public
 
@@ -229,7 +232,8 @@ class PullManager:
                 self._run_actions(actions)
 
     def on_node_dead(self, node_id: str):
-        chan = self._channels.get(node_id)
+        with self._lock:
+            chan = self._channels.get(node_id)
         if chan is not None:
             chan.close()  # receiver thread delivers the "closed" event
 
@@ -326,7 +330,8 @@ class PullManager:
         out = []
         policy = BackoffPolicy()
         for node in locations[:max(1, config.pull_max_sources)]:
-            chan = self._channels.get(node)
+            with self._lock:
+                chan = self._channels.get(node)
             if chan is not None and chan.alive:
                 out.append(chan)
                 continue
@@ -357,13 +362,27 @@ class PullManager:
             if chan is None:
                 self._no_data_plane[node] = time.monotonic() + 30.0
                 continue
-            self._channels[node] = chan
+            # Install under the lock, and never clobber a channel some
+            # other path installed while this dial was in flight — the
+            # overwritten entry would leak an open connection (its
+            # receiver thread would also keep feeding stale events).
+            with self._lock:
+                existing = self._channels.get(node)
+                if existing is not None and existing.alive \
+                        and existing is not chan:
+                    loser = chan
+                    chan = existing
+                else:
+                    self._channels[node] = chan
+                    loser = None
+            if loser is not None:
+                loser.close()
             out.append(chan)
         return out
 
     # ---------------------------------------------------------- admission
 
-    def _admit_locked(self) -> list:
+    def _admit_locked(self) -> list:  # requires: _lock
         """Admit queued pulls while under the in-flight byte cap (always at
         least one when nothing is active, so an object bigger than the cap
         still moves).  Returns channel actions to run outside the lock."""
@@ -388,7 +407,7 @@ class PullManager:
             actions.extend(self._start_locked(pull))
         return actions
 
-    def _start_locked(self, pull: _Pull) -> list:
+    def _start_locked(self, pull: _Pull) -> list:  # requires: _lock
         pull.channels = [c for c in pull.channels if c.alive]
         if not pull.channels:
             return [("fail", pull, [])]
@@ -405,7 +424,7 @@ class PullManager:
             return [("meta", pull.meta_chan, rid, pull.oid)]
         return self._activate_locked(pull)
 
-    def _activate_locked(self, pull: _Pull) -> list:
+    def _activate_locked(self, pull: _Pull) -> list:  # requires: _lock
         """Size known: allocate the destination and fan the first ranges
         out round-robin across every live holder."""
         pull.state = "active"
@@ -437,7 +456,7 @@ class PullManager:
         ][::-1]
         return self._assign_locked(pull)
 
-    def _assign_locked(self, pull: _Pull) -> list:
+    def _assign_locked(self, pull: _Pull) -> list:  # requires: _lock
         """Top up every live source to pipeline_depth outstanding ranges."""
         actions = []
         depth = max(1, config.pull_pipeline_depth)
@@ -521,7 +540,7 @@ class PullManager:
                 actions = self._drop_source_locked(pull, chan, rid)
         self._run_actions(actions)
 
-    def _drop_source_locked(self, pull: _Pull, chan: DataChannel,
+    def _drop_source_locked(self, pull: _Pull, chan: DataChannel,  # requires: _lock
                             rid: Optional[int]) -> list:
         if pull.state == "meta":
             pull.meta_tried += 1
@@ -596,7 +615,7 @@ class PullManager:
 
     # ------------------------------------------------------------ completion
 
-    def _teardown_locked(self, pull: _Pull):
+    def _teardown_locked(self, pull: _Pull):  # requires: _lock
         self._pulls.pop(pull.oid, None)
         for rid in list(pull.inflight):
             chan = pull.inflight[rid][0]

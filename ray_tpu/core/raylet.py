@@ -39,7 +39,7 @@ import threading
 import time
 import traceback
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import config
@@ -59,6 +59,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.util import chaos as _chaos
+from ray_tpu.util.locks import make_lock
 from ray_tpu.util.retry import BackoffPolicy
 
 config.define("gcs_reconnect_timeout_s", float, 0.0,
@@ -155,18 +156,16 @@ def _node_topology_labels() -> Dict[str, str]:
     prefer staying inside one slice."""
     labels: Dict[str, str] = {}
     env = os.environ
-    for key, sources in (
-            ("accelerator_type", ("RAY_TPU_ACCELERATOR_TYPE",
-                                  "TPU_ACCELERATOR_TYPE")),
-            ("tpu_slice", ("RAY_TPU_SLICE_ID", "TPU_NAME")),
-            ("tpu_topology", ("RAY_TPU_TOPOLOGY", "TPU_TOPOLOGY")),
-            ("tpu_worker_id", ("RAY_TPU_WORKER_ID", "TPU_WORKER_ID")),
+    for key, override, tpu_var in (
+            ("accelerator_type", config.accelerator_type,
+             "TPU_ACCELERATOR_TYPE"),
+            ("tpu_slice", config.slice_id, "TPU_NAME"),
+            ("tpu_topology", config.topology, "TPU_TOPOLOGY"),
+            ("tpu_worker_id", config.worker_id, "TPU_WORKER_ID"),
     ):
-        for var in sources:
-            val = env.get(var)
-            if val:
-                labels[key] = val
-                break
+        val = override or env.get(tpu_var)
+        if val:
+            labels[key] = val
     return labels
 
 
@@ -189,7 +188,7 @@ class _WorkerConn:
         # oid -> hold count announced by this process (auto-released on
         # process death)
         self.held: Dict[ObjectID, int] = {}
-        self.send_lock = threading.Lock()
+        self.send_lock = make_lock("worker_conn.send")
         self.rbuf = bytearray()  # partial-frame receive buffer
         self.sent_fns: set = set()  # function ids this worker has cached
 
@@ -247,7 +246,7 @@ class _PeerConn:
     def __init__(self, sock, node_id: str):
         self.sock = sock
         self.node_id = node_id
-        self.send_lock = threading.Lock()
+        self.send_lock = make_lock("peer_conn.send")
         self.rbuf = bytearray()  # partial-frame receive buffer
         # Chaos blackhole: a partitioned peer conn silently swallows every
         # outbound frame (the socket stays open — failure detection must
@@ -425,15 +424,15 @@ class Raylet:
 
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
-        self._inbox: deque = deque()
-        self._inbox_lock = threading.Lock()
+        self._inbox: deque = deque()  # guard: _inbox_lock
+        self._inbox_lock = make_lock("raylet.inbox")
         # Wake elision: _wake_armed=True means the loop is GUARANTEED to
         # drain the inbox without a wake byte — either a byte is already in
         # flight, or the loop is awake and will re-check the inbox before
         # blocking in select (it disarms under the lock right before a
         # blocking select).  A submission storm while the loop is busy
         # costs ZERO syscalls instead of one send per call_async.
-        self._wake_armed = False
+        self._wake_armed = False  # guard: _inbox_lock
 
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
@@ -530,8 +529,8 @@ class Raylet:
         self._pulls: Dict[ObjectID, dict] = {}          # oid -> pull state
         self._pull_by_rid: Dict[int, ObjectID] = {}
         self._pull_rid = itertools.count(1)
-        self._store = None  # raylet's own store client (pull serving/writing)
-        self._store_lock = threading.Lock()  # data-plane threads attach too
+        self._store = None  # guard: _store_lock — lazy attach, see _raylet_store
+        self._store_lock = make_lock("raylet.store")  # data-plane threads attach too
         # ---- zero-copy data plane (data_channel.py + pull_manager.py) ----
         self._data_server = None
         self._pull_manager = None
@@ -716,9 +715,10 @@ class Raylet:
             self._pull_manager.close()
         if self._data_server is not None:
             self._data_server.close()
-        if self._store is not None:
+        store = self._store  # unguarded-ok: shutdown; data plane closed above
+        if store is not None:
             try:
-                self._store.close()
+                store.close()
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1779,13 +1779,16 @@ class Raylet:
     def _raylet_store(self):
         # Also called from data-plane server/receiver threads: guard the
         # lazy attach so two threads never race two attachments.
-        if self._store is None and self.store_path:
+        # Double-checked locking: the unlocked probe only ever skips the
+        # attach when another thread already completed it (reference
+        # assignment is atomic under the GIL).
+        if self._store is None and self.store_path:  # unguarded-ok: DCL probe
             from ray_tpu.core.object_store import ShmObjectStore
 
             with self._store_lock:
                 if self._store is None:
                     self._store = ShmObjectStore(self.store_path)
-        return self._store
+        return self._store  # unguarded-ok: atomic reference read
 
     def _peer_data_addr(self, node_id: str):
         """(host, data_port) of a peer's data-plane listener, or None when
@@ -4128,7 +4131,7 @@ class Raylet:
             self._im["gcs_rpc_latency"].observe(seconds)
 
     def _spilled_bytes(self) -> int:
-        store = self._store
+        store = self._store  # unguarded-ok: atomic reference read (metrics sampling)
         spill_dir = getattr(store, "_spill_dir", None)
         if not spill_dir or not os.path.isdir(spill_dir):
             return 0

@@ -27,7 +27,7 @@ import pickle
 import struct
 
 import numpy as np
-from typing import Any, List, Tuple
+from typing import Any, List
 
 _MAGIC = 0x52545055  # "RTPU"
 _ALIGN = 64

@@ -6,14 +6,13 @@ Covers normal tasks, actor-creation tasks, and actor method calls.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.core.config import config
 from ray_tpu.core.ids import ActorID, FunctionID, ObjectID, TaskID
 
-
-_JOB_ID = os.environ.get("RAY_TPU_JOB_ID", "driver")
+_JOB_ID = config.job_id or "driver"
 
 
 def _default_job_id() -> str:

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -27,8 +26,9 @@ import cloudpickle
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import config
+from ray_tpu.util.locks import make_lock, make_rlock
 from ray_tpu.core.exceptions import GetTimeoutError, TaskError
-from ray_tpu.core.ids import FunctionID, ObjectID, TaskID, WorkerID, put_counter
+from ray_tpu.core.ids import FunctionID, ObjectID, WorkerID, put_counter
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import (
     InProcObjectStore,
@@ -43,7 +43,7 @@ WORKER = "worker"
 LOCAL = "local"
 
 _global_worker: Optional["Worker"] = None
-_init_lock = threading.Lock()
+_init_lock = make_lock("worker.init")
 
 # ---------------------------------------------------------------------------
 # Process-local reference counting (reference: ReferenceCounter,
@@ -51,11 +51,11 @@ _init_lock = threading.Lock()
 # call these; when this process's count for an object reaches zero the
 # worker tells its raylet, which frees the object once nobody holds it.
 
-_ref_counts: Dict["ObjectID", int] = {}
+_ref_counts: Dict["ObjectID", int] = {}  # guard: _ref_lock
 # RLock: a GC pass triggered by an allocation INSIDE these functions can
 # finalize an ObjectRef on the same thread, re-entering note_ref_dropped.
-_ref_lock = threading.RLock()
-_pending_events: List[tuple] = []  # ordered ("h"|"r", ObjectID)
+_ref_lock = make_rlock("worker.refcount")
+_pending_events: List[tuple] = []  # guard: _ref_lock
 # Batch threshold: freeing is latency-tolerant (a 0.5s raylet timer drains
 # stragglers), so a bigger batch just means fewer raylet hops — at 8 a 10k
 # fan-out cost ~2.5k event-loop posts; 64 cuts that 8x.
@@ -549,7 +549,7 @@ class DriverWorker(Worker):
 
         total = {"CPU": float(num_cpus if num_cpus is not None else os.cpu_count())}
         if num_tpus is None:
-            num_tpus = int(os.environ.get("RAY_TPU_NUM_CHIPS", "0"))
+            num_tpus = config.num_chips
             if num_tpus == 0 and "jax" in __import__("sys").modules:
                 try:
                     import jax

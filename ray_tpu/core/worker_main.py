@@ -37,6 +37,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.core.worker import WORKER, Worker, init_worker
+from ray_tpu.util.locks import make_lock
 
 
 class RemoteWorker(Worker):
@@ -45,15 +46,15 @@ class RemoteWorker(Worker):
     def __init__(self, sock: socket.socket):
         super().__init__(WORKER)
         self.sock = sock
-        self.send_lock = threading.Lock()
+        self.send_lock = make_lock("remote_worker.send")
         self.task_queue: "queue.Queue" = queue.Queue()
         # Actor concurrency (reference: threaded concurrency groups + asyncio
         # actors, `src/ray/core_worker/transport/concurrency_group_manager.cc`)
         self.actor_executor: Optional[ThreadPoolExecutor] = None
         self.group_executors: Optional[Dict[str, ThreadPoolExecutor]] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
-        self._rid = 0
-        self._rid_lock = threading.Lock()
+        self._rid = 0  # guard: _rid_lock
+        self._rid_lock = make_lock("remote_worker.rid")
         self._pending: Dict[int, dict] = {}
         # Done-message coalescing for batched dispatch: while more tasks
         # wait in the local queue, done frames buffer and flush in ONE
@@ -62,12 +63,15 @@ class RemoteWorker(Worker):
         # background flusher bounds the staleness to ~2ms so a fast task's
         # result is never held hostage by a slow batch member running
         # behind it.
-        self._done_buf: list = []
-        self._done_lock = threading.Lock()
+        self._done_buf: list = []  # guard: _done_lock
+        self._done_lock = make_lock("remote_worker.done")
         self._done_pending = threading.Event()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="worker-reader", daemon=True)
         self._reader.start()
-        self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         name="worker-done-flush",
+                                         daemon=True)
         self._flusher.start()
 
     def _flush_loop(self):
@@ -501,7 +505,7 @@ def main():
         "t": "register",
         "pid": os.getpid(),
         "worker_id": worker.worker_id,
-        "profile": os.environ.get("RAY_TPU_WORKER_PROFILE", "cpu"),
+        "profile": config.worker_profile or "cpu",
     })
     while True:
         msg = worker.task_queue.get()

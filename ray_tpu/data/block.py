@@ -10,7 +10,7 @@ supported via a secondary list-block kind.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+from typing import Any, Dict, Iterator, List, Union
 
 import numpy as np
 

@@ -14,11 +14,11 @@ from __future__ import annotations
 
 import builtins
 import os
-from typing import Any, Callable, List, Optional, Union
+from typing import Any, List, Optional, Union
 
 import numpy as np
 
-from ray_tpu.data.block import VALUE_COL, BlockAccessor, BlockMetadata
+from ray_tpu.data.block import VALUE_COL, BlockAccessor
 from ray_tpu.data.dataset import Dataset
 
 DEFAULT_PARALLELISM = 16

@@ -78,7 +78,8 @@ class _JobSupervisor:
         self._proc = subprocess.Popen(
             entrypoint, shell=True, env=env, start_new_session=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        self._pump = threading.Thread(target=self._pump_logs, daemon=True)
+        self._pump = threading.Thread(target=self._pump_logs,
+                                      name="job-log-pump", daemon=True)
         self._pump.start()
 
     def _put_info(self, info: dict):

@@ -43,7 +43,6 @@ stage are O(M + S) microbatch-slices either way.  See
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict
 
 import jax

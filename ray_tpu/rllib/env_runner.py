@@ -11,7 +11,7 @@ returns a SampleBatch (numpy — travels the object plane to the learner).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 

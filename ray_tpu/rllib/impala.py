@@ -15,7 +15,7 @@ drift between the behavior policy (runner weights) and the target policy
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict
 
 import numpy as np
 

@@ -13,7 +13,6 @@ CPU in tests); env stepping stays on CPU runner actors.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List
 
 import numpy as np

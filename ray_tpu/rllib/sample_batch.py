@@ -6,7 +6,7 @@ a dict of parallel arrays with concat/shuffle/minibatch helpers).
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List
+from typing import Iterator, List
 
 import numpy as np
 

@@ -7,7 +7,6 @@ Reference analogue: `python/ray/runtime_context.py`
 from __future__ import annotations
 
 import contextvars
-import os
 from typing import Optional
 
 __all__ = ["RuntimeContext", "get_runtime_context"]
@@ -26,7 +25,9 @@ class RuntimeContext:
             return w.raylet.node_id
         if w.mode == "client":
             return getattr(w, "node_id", None)
-        return os.environ.get("RAY_TPU_NODE_ID")
+        from ray_tpu.core.config import config
+
+        return config.node_id or None
 
     def get_worker_id(self) -> str:
         from ray_tpu.core.worker import global_worker
@@ -47,7 +48,9 @@ class RuntimeContext:
 
     @property
     def was_current_actor_reconstructed(self) -> bool:
-        return bool(int(os.environ.get("RAY_TPU_ACTOR_RESTARTS", "0")))
+        from ray_tpu.core.config import config
+
+        return bool(config.actor_restarts)
 
 
 def get_runtime_context() -> RuntimeContext:

@@ -21,7 +21,6 @@ import os
 import signal
 import subprocess
 import sys
-import time
 
 _PID_DIR = "/tmp/ray_tpu/pids"
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 NAMESPACE = "serve"
@@ -74,6 +74,7 @@ class ServeController:
         self._version = 0
         self._shutdown = False
         self._thread = threading.Thread(target=self._control_loop,
+                                        name="serve-controller",
                                         daemon=True)
         self._thread.start()
 

@@ -105,6 +105,7 @@ class HTTPProxy:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="serve-http-proxy",
                                         daemon=True)
         self._thread.start()
 

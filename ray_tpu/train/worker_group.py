@@ -18,7 +18,6 @@ from ray_tpu.train.session import (
     _TrainSession,
     _init_session,
     _shutdown_session,
-    get_session,
 )
 
 

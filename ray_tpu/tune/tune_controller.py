@@ -25,7 +25,6 @@ from ray_tpu.air.checkpoint_manager import CheckpointManager
 from ray_tpu.air.config import RunConfig
 from ray_tpu.air.result import Result
 from ray_tpu.tune.schedulers import (
-    CONTINUE,
     EXPLOIT,
     STOP,
     FIFOScheduler,

@@ -37,11 +37,32 @@ Reference analogue: `python/ray/_private/test_utils.py:1400`
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
 from typing import List, Optional
+
+from ray_tpu.core.config import config
+from ray_tpu.util.locks import make_lock
+
+config.define("chaos_net_seed", int, 0,
+              "Network-chaos deterministic RNG seed.", live=True)
+config.define("chaos_net_drop_p", float, 0.0,
+              "Network chaos: probability a frame/response is dropped "
+              "entirely.", live=True)
+config.define("chaos_net_delay_p", float, 0.0,
+              "Network chaos: probability a frame is delayed before "
+              "sending.", live=True)
+config.define("chaos_net_delay_ms", float, 0.0,
+              "Network chaos: injected delay, milliseconds.", live=True)
+config.define("chaos_net_blackhole_p", float, 0.0,
+              "Network chaos: probability a connection is partitioned — "
+              "every later frame on it vanishes silently.", live=True)
+config.define("chaos_net_channels", str, "data",
+              "Network chaos: csv of channels to afflict ('peer', "
+              "'data').  Defaults to data only — peer control frames "
+              "have no per-frame retry, so dropping them is an explicit "
+              "opt-in.", live=True)
 
 __all__ = ["NodeKiller", "NetworkChaos", "net_fault", "configure_net",
            "net"]
@@ -129,26 +150,21 @@ class NetworkChaos:
         self.seed = seed
         self.enabled = (self.drop_p > 0 or self.delay_p > 0
                         or self.blackhole_p > 0)
-        self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._rng = random.Random(seed)  # guard: _lock
+        self._lock = make_lock("chaos.net")
         # injected-fault counts by kind, for test assertions
         self.faults = {"drop": 0, "delay": 0, "blackhole": 0}
 
     @classmethod
     def from_env(cls) -> "NetworkChaos":
-        env = os.environ
-
-        def f(name, default=0.0):
-            try:
-                return float(env.get(f"RAY_TPU_CHAOS_NET_{name}", default))
-            except ValueError:
-                return default
-
-        channels = [c.strip() for c in env.get(
-            "RAY_TPU_CHAOS_NET_CHANNELS", "data").split(",") if c.strip()]
-        return cls(drop_p=f("DROP_P"), delay_p=f("DELAY_P"),
-                   delay_ms=f("DELAY_MS"), blackhole_p=f("BLACKHOLE_P"),
-                   seed=int(f("SEED", 0)), channels=channels)
+        channels = [c.strip()
+                    for c in config.chaos_net_channels.split(",")
+                    if c.strip()]
+        return cls(drop_p=config.chaos_net_drop_p,
+                   delay_p=config.chaos_net_delay_p,
+                   delay_ms=config.chaos_net_delay_ms,
+                   blackhole_p=config.chaos_net_blackhole_p,
+                   seed=config.chaos_net_seed, channels=channels)
 
     def decide(self, channel: str) -> Optional[str]:
         """Draw a fault for one frame on ``channel``:

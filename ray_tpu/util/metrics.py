@@ -21,12 +21,19 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.core.config import config
+from ray_tpu.util.locks import make_lock
+
 __all__ = ["Counter", "Gauge", "Histogram", "flush_metrics",
            "shutdown_metrics", "render_kv_metrics", "internal_metric",
            "INTERNAL_PREFIX"]
 
+config.define("metrics_flush_s", float, 1.0,
+              "Per-process user-metric flush period into the GCS metrics "
+              "KV (the dashboard's /metrics merges every producer).")
+
 _NS = "metrics"
-_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_FLUSH_S", "1.0"))
+_FLUSH_INTERVAL_S = config.metrics_flush_s
 
 # Metric names under this prefix are reserved for the runtime's own
 # instrumentation (scheduler queue depth, dispatch latency, ...) — user
@@ -36,10 +43,10 @@ _FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_FLUSH_S", "1.0"))
 # in cluster mode), not by the per-process flusher thread.
 INTERNAL_PREFIX = "ray_tpu_internal_"
 
-_registry_lock = threading.Lock()
-_registry: List["Metric"] = []
-_producer_id = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
-_flusher_started = False
+_registry_lock = make_lock("metrics.registry")
+_registry: List["Metric"] = []  # guard: _registry_lock
+_producer_id = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"  # guard: _registry_lock
+_flusher_started = False  # guard: _registry_lock
 _flusher_stop = threading.Event()
 _mk_internal = threading.local()
 
@@ -88,6 +95,9 @@ def flush_metrics():
             continue
         if payload is None:
             continue
+        # unguarded-ok: GIL-atomic str read; rotation only happens in
+        # shutdown_metrics, where a stale id at worst double-keys one final
+        # sample window (normal Prometheus counter-reset semantics).
         _kv_put(f"{_producer_id}/{m.name}".encode(),
                 json.dumps(payload).encode())
 
@@ -150,7 +160,7 @@ class Metric:
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
         self._default_key: Tuple = ()
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.metric")
         if not internal:
             with _registry_lock:
                 _registry.append(self)
@@ -186,7 +196,7 @@ class Counter(Metric):
 
     def __init__(self, name, description: str = "", tag_keys=None):
         super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, float] = {}
+        self._values: Dict[Tuple, float] = {}  # guard: _lock
 
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None):
@@ -210,7 +220,7 @@ class Gauge(Metric):
 
     def __init__(self, name, description: str = "", tag_keys=None):
         super().__init__(name, description, tag_keys)
-        self._values: Dict[Tuple, Tuple[float, float]] = {}  # key -> (v, ts)
+        self._values: Dict[Tuple, Tuple[float, float]] = {}  # guard: _lock
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = self._resolve_tags(tags)
@@ -242,7 +252,7 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self.boundaries = tuple(bounds)
         # key -> [bucket_counts..., +inf_count, sum, count]
-        self._values: Dict[Tuple, list] = {}
+        self._values: Dict[Tuple, list] = {}  # guard: _lock
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = self._resolve_tags(tags)

@@ -21,8 +21,15 @@ import contextvars
 import json
 import os
 import secrets
-import threading
 import time
+
+from ray_tpu.core.config import config
+from ray_tpu.util.locks import make_lock
+
+config.define("trace_dir", str, "",
+              "Span-export directory: tracing is enabled in any process "
+              "that sees this set (enable_tracing exports it so spawned "
+              "workers inherit the choice).", live=True)
 from typing import Any, Dict, Optional
 
 __all__ = ["enable_tracing", "tracing_enabled", "span", "current_trace_ctx"]
@@ -32,7 +39,7 @@ _ENV = "RAY_TPU_TRACE_DIR"
 _enabled = False
 _trace_dir: Optional[str] = None
 _file = None
-_file_lock = threading.Lock()
+_file_lock = make_lock("tracing.file")
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_trace_ctx", default=None)  # {"trace_id", "span_id"}
 
@@ -42,7 +49,7 @@ def enable_tracing(trace_dir: Optional[str] = None) -> str:
     is exported via the environment, which spawned workers inherit —
     reference: tracing startup hook).  Returns the trace dir."""
     global _enabled, _trace_dir
-    trace_dir = trace_dir or os.environ.get(_ENV) \
+    trace_dir = trace_dir or config.trace_dir \
         or os.path.join(os.path.expanduser("~"), ".ray_tpu", "traces")
     os.makedirs(trace_dir, exist_ok=True)
     os.environ[_ENV] = trace_dir
@@ -53,8 +60,8 @@ def enable_tracing(trace_dir: Optional[str] = None) -> str:
 
 def maybe_enable_from_env():
     """Called at worker startup: inherit the driver's tracing choice."""
-    if os.environ.get(_ENV):
-        enable_tracing(os.environ[_ENV])
+    if config.trace_dir:
+        enable_tracing(config.trace_dir)
 
 
 def tracing_enabled() -> bool:
@@ -148,7 +155,7 @@ def read_spans(trace_dir: Optional[str] = None,
     ``name_prefix`` filters at read time (e.g. ``"task.submit"`` — the
     timeline's flow-event feed) so callers don't materialize every
     execution span of a long run just to pick out the submits."""
-    trace_dir = trace_dir or _trace_dir or os.environ.get(_ENV)
+    trace_dir = trace_dir or _trace_dir or config.trace_dir or None
     out = []
     if not trace_dir or not os.path.isdir(trace_dir):
         return out

@@ -21,7 +21,12 @@ import shutil
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.core.config import config
 from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+
+config.define("workflow_dir", str, "",
+              "Durable workflow storage root (default "
+              "~/.ray_tpu/workflows).", live=True)
 
 __all__ = ["run", "resume", "get_output", "get_status", "list_all",
            "delete", "init_storage"]
@@ -39,9 +44,8 @@ def init_storage(path: str):
 def _storage() -> str:
     global _storage_dir
     if _storage_dir is None:
-        _storage_dir = os.path.join(
-            os.environ.get("RAY_TPU_WORKFLOW_DIR",
-                           os.path.expanduser("~/.ray_tpu/workflows")))
+        _storage_dir = (config.workflow_dir
+                        or os.path.expanduser("~/.ray_tpu/workflows"))
         os.makedirs(_storage_dir, exist_ok=True)
     return _storage_dir
 

@@ -1,0 +1,495 @@
+"""Unit tests for the static-analysis suite (tools/analysis) and the
+DebugLock lock-order watchdog.
+
+Each pass gets fixture snippets proving it catches its target defect
+shape AND stays quiet on the sanctioned patterns; the end-to-end test
+asserts the repository itself is clean (the CI gate).  This file is
+excluded from the env-var completeness scan (tools.analysis.SCAN_EXCLUDE)
+because the fixtures deliberately contain rogue variables.
+"""
+
+import os
+import threading
+import textwrap
+
+from tools import analysis
+from tools.analysis import (blocking_under_lock, env_registry,
+                            lock_discipline, thread_hygiene)
+from tools.analysis.common import SourceFile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def sf(snippet: str, rel: str = "ray_tpu/core/fake.py") -> SourceFile:
+    return SourceFile(rel, rel=rel, src=textwrap.dedent(snippet))
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+
+class TestLockDiscipline:
+    def test_guarded_field_miss_is_flagged(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stats = 0  # guard: _lock
+
+                def bump(self):
+                    self._stats += 1
+        """))
+        assert len(out) == 1
+        assert "self._stats" in out[0].message
+        assert out[0].line == 9
+
+    def test_with_lock_access_passes(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stats = 0  # guard: _lock
+
+                def bump(self):
+                    with self._lock:
+                        self._stats += 1
+        """))
+        assert out == []
+
+    def test_declaring_method_is_exempt(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stats = 0  # guard: _lock
+                    self._stats = self._stats + 1
+        """))
+        assert out == []
+
+    def test_unguarded_ok_suppresses(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._flag = False  # guard: _lock
+
+                def probe(self):
+                    return self._flag  # unguarded-ok: GIL-atomic read
+        """))
+        assert out == []
+
+    def test_requires_method_body_is_lock_context(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []  # guard: _lock
+
+                def _drain_locked(self):  # requires: _lock
+                    self._q.clear()
+
+                def drain(self):
+                    with self._lock:
+                        self._drain_locked()
+        """))
+        assert out == []
+
+    def test_call_to_requires_method_without_lock_is_flagged(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []  # guard: _lock
+
+                def _drain_locked(self):  # requires: _lock
+                    self._q.clear()
+
+                def drain(self):
+                    self._drain_locked()
+        """))
+        assert len(out) == 1
+        assert "_drain_locked" in out[0].message
+
+    def test_closure_does_not_inherit_with_block(self):
+        # a callback defined under `with` runs LATER, without the lock
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0  # guard: _lock
+
+                def arm(self, post):
+                    with self._lock:
+                        def cb():
+                            self._n += 1
+                        post(cb)
+        """))
+        assert len(out) == 1
+        assert out[0].line == 11
+
+    def test_module_level_guard(self):
+        out = lock_discipline.check(sf("""\
+            import threading
+
+            _lk = threading.Lock()
+            _registry = []  # guard: _lk
+
+            def good():
+                with _lk:
+                    _registry.append(1)
+
+            def bad():
+                _registry.append(2)
+        """))
+        assert len(out) == 1
+        assert out[0].line == 11
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+
+
+class TestBlockingUnderLock:
+    def test_socket_send_under_lock_is_flagged(self):
+        out = blocking_under_lock.check(sf("""\
+            class C:
+                def send(self, msg):
+                    with self._inbox_lock:
+                        self.sock.sendall(msg)
+        """))
+        assert len(out) == 1
+        assert ".sendall" in out[0].message
+
+    def test_sleep_and_subprocess_under_lock(self):
+        out = blocking_under_lock.check(sf("""\
+            import subprocess
+            import time
+
+            class C:
+                def spin(self):
+                    with self._lock:
+                        time.sleep(1)
+                        subprocess.run(["true"])
+        """))
+        assert len(out) == 2
+
+    def test_thread_join_and_result_under_lock(self):
+        out = blocking_under_lock.check(sf("""\
+            class C:
+                def stop(self):
+                    with self._lock:
+                        self._recv_thread.join()
+                        self._fut.result()
+        """))
+        assert len(out) == 2
+
+    def test_outside_lock_is_fine(self):
+        out = blocking_under_lock.check(sf("""\
+            class C:
+                def send(self, msg):
+                    with self._lock:
+                        frame = self.encode(msg)
+                    self.sock.sendall(frame)
+        """))
+        assert out == []
+
+    def test_blocking_ok_suppresses(self):
+        out = blocking_under_lock.check(sf("""\
+            class C:
+                def send(self, msg):
+                    with self._send_lock:
+                        # blocking-ok: send lock serializes this socket only
+                        self.sock.sendall(msg)
+        """))
+        assert out == []
+
+    def test_requires_method_counts_as_held(self):
+        out = blocking_under_lock.check(sf("""\
+            import time
+
+            class C:
+                def _tick_locked(self):  # requires: _lock
+                    time.sleep(0.1)
+        """))
+        assert len(out) == 1
+
+    def test_str_join_not_flagged(self):
+        out = blocking_under_lock.check(sf("""\
+            class C:
+                def fmt(self, parts):
+                    with self._lock:
+                        return ",".join(parts) + self.sep.join(parts)
+        """))
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# env-registry
+
+
+class TestEnvRegistry:
+    def test_rogue_read_is_flagged(self):
+        out = env_registry.check_rogue_reads([sf("""\
+            import os
+
+            def f():
+                return os.environ.get("RAY_TPU_BOGUS", "0")
+        """)])
+        assert len(out) == 1
+        assert "RAY_TPU_BOGUS" in out[0].message
+
+    def test_alias_and_subscript_reads_are_flagged(self):
+        out = env_registry.check_rogue_reads([sf("""\
+            import os
+
+            _VAR = "RAY_TPU_SNEAKY"
+
+            def f():
+                env = os.environ
+                a = env.get("RAY_TPU_ONE")
+                b = os.environ[_VAR]
+                c = os.getenv("RAY_TPU_TWO")
+                return a, b, c
+        """)])
+        assert len(out) == 3
+
+    def test_env_write_is_allowed(self):
+        out = env_registry.check_rogue_reads([sf("""\
+            import os
+
+            def f(v):
+                os.environ["RAY_TPU_TRACE_DIR"] = v
+        """)])
+        assert out == []
+
+    def test_registry_module_is_allowed(self):
+        out = env_registry.check_rogue_reads([sf("""\
+            import os
+
+            def f(name):
+                return os.environ.get("RAY_TPU_" + name)
+        """, rel="ray_tpu/core/config.py")])
+        assert out == []
+
+    def test_env_ok_suppresses(self):
+        out = env_registry.check_rogue_reads([sf("""\
+            import os
+
+            def f():
+                return os.environ.get("RAY_TPU_ODD")  # env-ok: bootstrap, registry not importable here
+        """)])
+        assert out == []
+
+    def test_completeness_catches_undeclared_var(self):
+        files = [sf("""\
+            KNOWN = "RAY_TPU_DECLARED"
+            GENERIC_PREFIX = "RAY_TPU_"
+            UNKNOWN = "RAY_TPU_NOT_A_FLAG"
+        """)]
+        defs = [env_registry.FlagDef("declared", "str", "''", "", False,
+                                     "ray_tpu/core/config.py", 1)]
+        out = env_registry.check_completeness(files, defs)
+        assert len(out) == 1
+        assert "RAY_TPU_NOT_A_FLAG" in out[0].message
+
+    def test_real_registry_collection(self):
+        files = analysis.load_files(
+            analysis.iter_py_files(os.path.join(REPO_ROOT, "ray_tpu")),
+            REPO_ROOT)
+        defs = env_registry.collect_defines(files)
+        names = {d.name for d in defs}
+        # a few load-bearing flags that must stay declared
+        assert {"data_channel", "task_events", "debug_locks",
+                "chaos_net_drop_p", "metrics_flush_s", "node_id",
+                "job_id"} <= names
+        assert not env_registry.check_duplicates(defs)
+        live = {d.name for d in defs if d.live}
+        assert "node_id" in live and "data_channel" not in live
+
+
+# ---------------------------------------------------------------------------
+# thread-hygiene
+
+
+class TestThreadHygiene:
+    def test_unnamed_thread_is_flagged(self):
+        out = thread_hygiene.check(sf("""\
+            import threading
+
+            threading.Thread(target=print, daemon=True).start()
+        """))
+        assert len(out) == 1
+        assert "name=" in out[0].message
+
+    def test_named_daemon_passes(self):
+        out = thread_hygiene.check(sf("""\
+            import threading
+
+            threading.Thread(target=print, name="t", daemon=True).start()
+        """))
+        assert out == []
+
+    def test_non_daemon_needs_joiner(self):
+        out = thread_hygiene.check(sf("""\
+            import threading
+
+            threading.Thread(target=print, name="t").start()
+        """))
+        assert len(out) == 1
+        assert "joined-by" in out[0].message
+
+    def test_joined_by_comment_passes(self):
+        out = thread_hygiene.check(sf("""\
+            import threading
+
+            t = threading.Thread(target=print, name="t")  # joined-by: stop()
+        """))
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# suite-level
+
+
+class TestSuite:
+    def test_repo_is_clean(self):
+        """The CI gate: the tree itself passes all four passes with zero
+        unexplained suppressions."""
+        violations, suppressions, defs = analysis.analyze(REPO_ROOT)
+        assert violations == [], "\n".join(str(v) for v in violations)
+        assert all(s.reason for s in suppressions)
+        assert len(defs) > 50
+
+    def test_readme_table_lists_every_flag(self):
+        with open(os.path.join(REPO_ROOT, "README.md")) as f:
+            readme = f.read()
+        files = analysis.load_files(
+            analysis.iter_py_files(os.path.join(REPO_ROOT, "ray_tpu")),
+            REPO_ROOT)
+        for d in env_registry.collect_defines(files):
+            assert f"`{d.env_name}`" in readme, \
+                f"{d.env_name} missing from README env table"
+
+
+# ---------------------------------------------------------------------------
+# DebugLock runtime watchdog
+
+
+class TestDebugLock:
+    def setup_method(self):
+        from ray_tpu.util import locks
+        locks.reset_lock_order_state()
+
+    def teardown_method(self):
+        from ray_tpu.util import locks
+        locks.reset_lock_order_state()
+
+    def test_abba_cycle_is_reported_with_both_stacks(self, capsys):
+        from ray_tpu.util.locks import DebugLock, lock_order_violations
+
+        a = DebugLock("abba.A")
+        b = DebugLock("abba.B")
+        order = []
+
+        def t1():
+            with a:
+                with b:
+                    order.append("t1")
+
+        def t2():
+            with b:
+                with a:
+                    order.append("t2")
+
+        # Sequential threads: the orderings never actually race, but the
+        # watchdog still flags the LATENT cycle — that is the point.
+        for fn, name in ((t1, "abba-1"), (t2, "abba-2")):
+            th = threading.Thread(target=fn, name=name)
+            th.start()
+            th.join(10)
+        assert order == ["t1", "t2"]
+        violations = lock_order_violations()
+        assert len(violations) == 1
+        cycle = violations[0]["cycle"]
+        assert cycle[0] == cycle[-1] and {"abba.A", "abba.B"} == set(cycle)
+        stacks = violations[0]["stacks"]
+        assert len(stacks) == 2  # both orderings' stacks
+        assert all("abba" in s or "in t" in s for s in stacks)
+        assert "POTENTIAL DEADLOCK" in capsys.readouterr().err
+
+    def test_consistent_order_is_silent(self):
+        from ray_tpu.util.locks import DebugLock, lock_order_violations
+
+        a = DebugLock("ord.A")
+        b = DebugLock("ord.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert lock_order_violations() == []
+
+    def test_reentrant_lock_is_not_a_cycle(self):
+        from ray_tpu.util.locks import DebugLock, lock_order_violations
+
+        r = DebugLock("reent.R", reentrant=True)
+        with r:
+            with r:
+                pass
+        assert lock_order_violations() == []
+
+    def test_three_lock_cycle(self):
+        from ray_tpu.util.locks import DebugLock, lock_order_violations
+
+        a, b, c = (DebugLock(f"tri.{x}") for x in "ABC")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        violations = lock_order_violations()
+        assert len(violations) == 1
+        assert len(set(violations[0]["cycle"])) == 3
+
+    def test_try_acquire_records_no_edge(self):
+        from ray_tpu.util.locks import DebugLock, lock_order_violations
+
+        a = DebugLock("try.A")
+        b = DebugLock("try.B")
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        with b:
+            with a:
+                pass
+        assert lock_order_violations() == []
+
+    def test_make_lock_is_env_gated(self, monkeypatch):
+        from ray_tpu.util.locks import DebugLock, make_lock, make_rlock
+
+        monkeypatch.delenv("RAY_TPU_DEBUG_LOCKS", raising=False)
+        assert isinstance(make_lock("gate.plain"), type(threading.Lock()))
+        monkeypatch.setenv("RAY_TPU_DEBUG_LOCKS", "1")
+        assert isinstance(make_lock("gate.debug"), DebugLock)
+        rl = make_rlock("gate.rdebug")
+        assert isinstance(rl, DebugLock)
+        with rl:
+            with rl:
+                pass

@@ -9,7 +9,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ray_tpu.models import gpt2, llama, mnist
-from ray_tpu.parallel.sharding import ShardingConfig, param_shardings, shard_params
+from ray_tpu.parallel.sharding import ShardingConfig, shard_params
 
 
 def test_gpt2_forward_shapes():

@@ -10,7 +10,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ray_tpu.ops.flash_attention import _reference_attention, flash_attention
 from ray_tpu.parallel.mesh import create_mesh
 from ray_tpu.parallel.ring_attention import (
-    ring_attention,
     ring_attention_sharded,
     ulysses_attention,
 )

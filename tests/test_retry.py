@@ -1,0 +1,102 @@
+"""Direct unit coverage for util/retry.py BackoffPolicy (previously only
+exercised indirectly through the chaos tests): jitter bounds, delay cap,
+seeded determinism, and config/env override resolution."""
+
+import pytest
+
+from ray_tpu.core.config import config
+from ray_tpu.util.retry import BackoffPolicy
+
+
+class TestBackoffPolicy:
+    def test_exponential_progression_without_jitter(self):
+        p = BackoffPolicy(base_s=0.1, max_s=100.0, multiplier=2.0, jitter=0.0)
+        assert p.delay(0) == pytest.approx(0.1)
+        assert p.delay(1) == pytest.approx(0.2)
+        assert p.delay(4) == pytest.approx(1.6)
+
+    def test_cap_is_respected(self):
+        p = BackoffPolicy(base_s=0.5, max_s=3.0, multiplier=2.0, jitter=0.0)
+        assert p.delay(10) == pytest.approx(3.0)
+        assert p.delay(100) == pytest.approx(3.0)
+        # jitter applies AFTER the cap, so the ceiling can stretch by at
+        # most the jitter fraction — never unboundedly
+        pj = BackoffPolicy(base_s=0.5, max_s=3.0, multiplier=2.0,
+                           jitter=0.2, seed=7)
+        for attempt in range(50):
+            assert pj.delay(attempt) <= 3.0 * 1.2 + 1e-9
+
+    def test_jitter_stays_within_bounds(self):
+        p = BackoffPolicy(base_s=1.0, max_s=1000.0, multiplier=1.0,
+                          jitter=0.25, seed=42)
+        seen_low = seen_high = False
+        for _ in range(500):
+            d = p.delay(0)
+            assert 0.75 - 1e-9 <= d <= 1.25 + 1e-9
+            seen_low |= d < 0.95
+            seen_high |= d > 1.05
+        assert seen_low and seen_high  # jitter actually spreads
+
+    def test_negative_attempt_clamps(self):
+        p = BackoffPolicy(base_s=0.1, max_s=5.0, multiplier=2.0, jitter=0.0)
+        assert p.delay(-3) == pytest.approx(0.1)
+
+    def test_delay_never_negative(self):
+        p = BackoffPolicy(base_s=0.1, max_s=5.0, multiplier=2.0,
+                          jitter=0.99, seed=3)
+        assert all(p.delay(a) >= 0.0 for a in range(30))
+
+    def test_seeded_determinism(self):
+        a = BackoffPolicy(base_s=0.2, max_s=9.0, multiplier=2.0,
+                          jitter=0.3, seed=123)
+        b = BackoffPolicy(base_s=0.2, max_s=9.0, multiplier=2.0,
+                          jitter=0.3, seed=123)
+        seq_a = [a.delay(i) for i in range(20)]
+        seq_b = [b.delay(i) for i in range(20)]
+        assert seq_a == seq_b
+        c = BackoffPolicy(base_s=0.2, max_s=9.0, multiplier=2.0,
+                          jitter=0.3, seed=124)
+        assert [c.delay(i) for i in range(20)] != seq_a
+
+    def test_zero_jitter_ignores_rng(self):
+        a = BackoffPolicy(base_s=0.2, max_s=9.0, multiplier=3.0,
+                          jitter=0.0, seed=1)
+        assert [a.delay(i) for i in range(5)] == \
+            [a.delay(i) for i in range(5)]
+
+    def test_defaults_resolve_from_config_registry(self):
+        p = BackoffPolicy()
+        assert p.base_s == config.retry_backoff_base_s
+        assert p.max_s == config.retry_backoff_max_s
+        assert p.multiplier == config.retry_backoff_multiplier
+        assert p.jitter == config.retry_backoff_jitter
+
+    def test_env_override_parsing(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_RETRY_BACKOFF_BASE_S", "0.75")
+        monkeypatch.setenv("RAY_TPU_RETRY_BACKOFF_MAX_S", "2.5")
+        config.reload("retry_backoff_base_s", "retry_backoff_max_s")
+        try:
+            p = BackoffPolicy(jitter=0.0)
+            assert p.base_s == pytest.approx(0.75)
+            assert p.delay(0) == pytest.approx(0.75)
+            assert p.delay(10) == pytest.approx(2.5)
+        finally:
+            monkeypatch.delenv("RAY_TPU_RETRY_BACKOFF_BASE_S")
+            monkeypatch.delenv("RAY_TPU_RETRY_BACKOFF_MAX_S")
+            config.reload("retry_backoff_base_s", "retry_backoff_max_s")
+        assert BackoffPolicy().base_s == pytest.approx(0.2)
+
+    def test_malformed_env_override_falls_back(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_RETRY_BACKOFF_BASE_S", "not-a-float")
+        config.reload("retry_backoff_base_s")
+        try:
+            # defensive parse keeps the previous value instead of raising
+            assert BackoffPolicy().base_s == pytest.approx(0.2)
+        finally:
+            monkeypatch.delenv("RAY_TPU_RETRY_BACKOFF_BASE_S")
+            config.reload("retry_backoff_base_s")
+
+    def test_explicit_args_beat_config(self):
+        p = BackoffPolicy(base_s=9.0)
+        assert p.base_s == 9.0
+        assert p.max_s == config.retry_backoff_max_s

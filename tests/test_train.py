@@ -8,7 +8,6 @@ processes x 4 virtual CPU devices = one 8-device global mesh.
 """
 
 import os
-import time
 
 import numpy as np
 import pytest

@@ -10,7 +10,6 @@ import time
 import pytest
 
 from ray_tpu import tune
-from ray_tpu.tune.schedulers import EXPLOIT
 
 
 @pytest.fixture(scope="module")

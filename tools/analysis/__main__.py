@@ -1,0 +1,67 @@
+"""CLI: ``python -m tools.analysis [--write-env-table] [--list-suppressions]``."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+from tools import analysis
+from tools.analysis import env_registry
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="ray_tpu concurrency & config static-analysis suite")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--write-env-table", action="store_true",
+                        help="regenerate the README env-var table in place")
+    parser.add_argument("--list-suppressions", action="store_true",
+                        help="print every escape-hatch annotation in use")
+    args = parser.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    violations, suppressions, defs = analysis.analyze(root)
+
+    if args.write_env_table:
+        readme = os.path.join(root, "README.md")
+        with open(readme, encoding="utf-8") as f:
+            src = f.read()
+        updated = env_registry.readme_with_table(src, defs)
+        if updated != src:
+            with open(readme, "w", encoding="utf-8") as f:
+                f.write(updated)
+            print("README.md env-var table updated "
+                  f"({len(defs)} flags).")
+        else:
+            print("README.md env-var table already up to date.")
+        # table freshness violations no longer apply to the new file
+        violations = [v for v in violations
+                      if "env-var table" not in v.message]
+
+    if args.list_suppressions:
+        for sup in suppressions:
+            print(f"{sup.path}:{sup.line}: {sup.kind}: "
+                  f"{sup.reason or '(NO REASON)'}")
+        print(f"-- {len(suppressions)} suppressions")
+
+    for v in violations:
+        print(v)
+    counts = Counter(v.pass_name for v in violations)
+    if violations:
+        summary = ", ".join(f"{n} {p}" for p, n in sorted(counts.items()))
+        print(f"\nFAIL: {len(violations)} violation(s) ({summary})")
+        return 1
+    print(f"OK: 0 violations across 4 passes "
+          f"({len(defs)} env flags declared, "
+          f"{len(suppressions)} explained suppressions).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

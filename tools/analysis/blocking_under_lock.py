@@ -1,0 +1,147 @@
+"""Blocking-call-under-lock detector.
+
+Flags calls that can block indefinitely — socket sends/receives/connects,
+``time.sleep``, ``subprocess.*``, ``Thread.join``, ``Future.result()`` —
+made while a lock is lexically held (``with <something named *lock*>:`` or
+inside a ``# requires: <lock>`` method).  This is exactly the shape of the
+control-hot-path hazards the runtime has been bitten by: a peer send
+while holding an event-loop lock turns one slow consumer into a stalled
+raylet, and two nodes doing it to each other into a distributed deadlock.
+
+Some sites hold a lock WHOSE PURPOSE is serializing the blocking call
+(per-socket send locks).  Those are annotated
+``# blocking-ok: <reason>`` — the reason is mandatory and audited.
+
+Known lexical limits: receivers are matched by name, so a ``.join()`` on
+something not named like a thread/process, or a socket reached through an
+unusual alias, is invisible; conversely ``.send()`` on a non-socket would
+be flagged (suppress with a reason).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analysis.common import (REQUIRES_RE, SourceFile, Violation,
+                                   dotted_name)
+
+PASS = "blocking-under-lock"
+
+#: method names that block on the network / disk regardless of receiver
+BLOCKING_METHODS = {
+    "send", "sendall", "sendmsg", "sendto", "sendfile",
+    "recv", "recv_into", "recvfrom", "recvfrom_into",
+    "accept", "connect", "connect_ex",
+    "result",
+}
+
+#: module-level calls that block
+BLOCKING_CALLS = {
+    "time.sleep",
+    "socket.create_connection", "socket.create_server",
+}
+
+BLOCKING_MODULE_PREFIXES = ("subprocess.",)
+
+
+def _is_lockish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return "lock" in name.rsplit(".", 1)[-1].lower()
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: List[Violation],
+                 held: List[str]):
+        self.sf = sf
+        self.out = out
+        self.held = held  # stack of held lock expr names
+
+    def visit_With(self, node: ast.With):
+        # context expressions run before the lock is taken
+        for item in node.items:
+            self.visit(item.context_expr)
+        added = 0
+        for item in node.items:
+            name = dotted_name(item.context_expr)
+            if name is None and isinstance(item.context_expr, ast.Call):
+                name = dotted_name(item.context_expr.func)
+            if _is_lockish(name):
+                self.held.append(name)
+                added += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(added):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _enter_closure(self, node):
+        inner = _Checker(self.sf, self.out, [])
+        for child in ast.iter_child_nodes(node):
+            inner.visit(child)
+
+    def visit_FunctionDef(self, node):
+        self._enter_closure(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if self.held:
+            reason = self._blocking_reason(node)
+            if reason is not None \
+                    and self.sf.suppression(node.lineno, "blocking-ok",
+                                            node.end_lineno) is None:
+                self.out.append(Violation(
+                    self.sf.rel, node.lineno, PASS,
+                    f"{reason} while holding {self.held[-1]} — move it "
+                    f"outside the lock or annotate "
+                    f"'# blocking-ok: <reason>'"))
+        self.generic_visit(node)
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name:
+            if name in BLOCKING_CALLS:
+                return f"blocking call {name}()"
+            if name.startswith(BLOCKING_MODULE_PREFIXES):
+                return f"subprocess call {name}()"
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth in BLOCKING_METHODS:
+                # `.result()` on anything; sends/recvs on anything but an
+                # obvious string/bytes constant receiver
+                if isinstance(node.func.value, ast.Constant):
+                    return None
+                return f"potentially blocking .{meth}()"
+            if meth == "join":
+                recv = dotted_name(node.func.value) or ""
+                last = recv.rsplit(".", 1)[-1].lower()
+                if "thread" in last or "proc" in last:
+                    return f"Thread.join on {recv}"
+        return None
+
+
+def check(sf: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+
+    def walk_fn(fn):
+        held: List[str] = []
+        req = sf.signature_comment(fn, REQUIRES_RE)
+        if req:
+            held.append(f"self.{req}")
+        checker = _Checker(sf, out, held)
+        for child in ast.iter_child_nodes(fn):
+            checker.visit(child)
+
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_fn(item)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(stmt)
+
+    return out

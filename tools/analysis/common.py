@@ -1,0 +1,160 @@
+"""Shared infrastructure for the static-analysis passes.
+
+Every pass consumes a :class:`SourceFile` (parsed AST + per-line comment
+map) and yields :class:`Violation` rows.  Escape-hatch comments
+(``# unguarded-ok: <reason>``, ``# blocking-ok: <reason>``,
+``# env-ok: <reason>``, ``# joined-by: <what>``) are resolved here with one
+rule: a suppression covers the code line it trails, or — when written as a
+full-line comment — the next non-comment line below it (a contiguous
+comment block counts as one).  A suppression whose reason is empty is
+itself reported: the suite's contract is zero UNEXPLAINED suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+#: escape-hatch / annotation comment markers understood by the passes
+SUPPRESSION_KINDS = ("unguarded-ok", "blocking-ok", "env-ok", "joined-by")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*(" + "|".join(SUPPRESSION_KINDS) + r")\s*:?\s*(.*)")
+GUARD_RE = re.compile(r"#\s*guard:\s*([A-Za-z_][A-Za-z0-9_]*)")
+REQUIRES_RE = re.compile(r"#\s*requires:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    pass_name: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    path: str
+    line: int
+    kind: str
+    reason: str
+
+
+class SourceFile:
+    """One parsed python file: source, AST, and tokenized comments."""
+
+    def __init__(self, path: str, rel: Optional[str] = None,
+                 src: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        if src is None:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+
+    # ---- comment helpers --------------------------------------------------
+
+    def _is_comment_only_line(self, line: int) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        stripped = self.lines[line - 1].strip()
+        return stripped.startswith("#")
+
+    def comments_covering(self, line: int) -> List[tuple]:
+        """(lineno, text) of the trailing comment on ``line`` plus the
+        contiguous full-line comment block immediately above it."""
+        out = []
+        if line in self.comments and not self._is_comment_only_line(line):
+            out.append((line, self.comments[line]))
+        above = line - 1
+        while above >= 1 and self._is_comment_only_line(above):
+            out.append((above, self.comments.get(above, "")))
+            above -= 1
+        return out
+
+    def suppression(self, line: int, kind: str,
+                    end_line: Optional[int] = None) -> Optional[Suppression]:
+        """The ``kind`` escape hatch covering ``line`` (or any line of the
+        ``line``..``end_line`` statement range), if any."""
+        candidates = list(self.comments_covering(line))
+        for extra in range(line + 1, (end_line or line) + 1):
+            if extra in self.comments \
+                    and not self._is_comment_only_line(extra):
+                candidates.append((extra, self.comments[extra]))
+        for lineno, text in candidates:
+            m = _SUPPRESS_RE.search(text)
+            if m and m.group(1) == kind:
+                return Suppression(self.rel, lineno, kind,
+                                   m.group(2).strip())
+        return None
+
+    def all_suppressions(self) -> List[Suppression]:
+        out = []
+        for lineno, text in sorted(self.comments.items()):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                out.append(Suppression(self.rel, lineno, m.group(1),
+                                       m.group(2).strip()))
+        return out
+
+    def signature_comment(self, fn: ast.AST, regex: re.Pattern) \
+            -> Optional[str]:
+        """Match ``regex`` against comments in a def's signature region
+        (the ``def`` line through the line before the first body
+        statement) — where ``# requires: <lock>`` annotations live."""
+        end = fn.body[0].lineno - 1 if fn.body else fn.lineno
+        for line in range(fn.lineno, end + 1):
+            text = self.comments.get(line)
+            if text:
+                m = regex.search(text)
+                if m:
+                    return m.group(1)
+        return None
+
+
+def iter_py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d != "__pycache__" and not d.startswith(".")]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return out
+
+
+def load_files(paths: Iterable[str], repo_root: str) -> List[SourceFile]:
+    out = []
+    for path in paths:
+        rel = os.path.relpath(path, repo_root)
+        out.append(SourceFile(path, rel=rel))
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

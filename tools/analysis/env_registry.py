@@ -1,0 +1,250 @@
+"""Env-flag registry checker + README table generator.
+
+Three jobs:
+
+1. **Declaration inventory** — AST-collect every ``config.define(...)``
+   call in the package: flag name, type, default (source form), docstring,
+   ``live`` marker, definition site.  Duplicate definitions of one flag
+   are violations.
+
+2. **Rogue-read rejection** — any direct ``os.environ`` / ``os.getenv``
+   READ of a ``RAY_TPU_*`` key outside ``core/config.py`` is a violation
+   (``# env-ok: <reason>`` escapes, reason mandatory).  Env WRITES are
+   allowed: propagating identity into a child process's environment is the
+   sanctioned transport; the child reads it back through the registry.
+   Local aliases (``env = os.environ``) are tracked per function scope.
+
+3. **Completeness** — every ``RAY_TPU_<NAME>`` string literal anywhere in
+   the scanned tree must correspond to a declared flag (or be a prefix of
+   one, for f-string key construction).  This is what keeps the README
+   reference table — generated from the same inventory — exhaustive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from tools.analysis.common import SourceFile, Violation, dotted_name
+
+PASS = "env-registry"
+ENV_PREFIX = "RAY_TPU_"
+_TOKEN_RE = re.compile(r"(?<![A-Za-z0-9_])RAY_TPU_[A-Z0-9_]*")
+
+#: the one module allowed to read RAY_TPU_* from the environment
+REGISTRY_MODULE = "ray_tpu/core/config.py"
+
+
+@dataclass
+class FlagDef:
+    name: str
+    type: str
+    default: str
+    doc: str
+    live: bool
+    path: str
+    line: int
+
+    @property
+    def env_name(self) -> str:
+        return ENV_PREFIX + self.name.upper()
+
+
+def collect_defines(files: List[SourceFile]) -> List[FlagDef]:
+    out: List[FlagDef] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "config.define":
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant):
+                continue
+            name = node.args[0].value
+            type_ = (ast.unparse(node.args[1])
+                     if len(node.args) > 1 else "?")
+            default = (ast.unparse(node.args[2])
+                       if len(node.args) > 2 else "?")
+            doc = ""
+            if len(node.args) > 3 and isinstance(node.args[3], ast.Constant):
+                doc = str(node.args[3].value)
+            live = False
+            for kw in node.keywords:
+                if kw.arg == "doc" and isinstance(kw.value, ast.Constant):
+                    doc = str(kw.value.value)
+                elif kw.arg == "live":
+                    live = (isinstance(kw.value, ast.Constant)
+                            and bool(kw.value.value))
+            out.append(FlagDef(name, type_, default, " ".join(doc.split()),
+                               live, sf.rel, node.lineno))
+    return out
+
+
+class _ReadFinder(ast.NodeVisitor):
+    """Finds RAY_TPU_* environment READS in one file."""
+
+    def __init__(self, sf: SourceFile, module_consts: Dict[str, str],
+                 out: List[Violation]):
+        self.sf = sf
+        self.module_consts = module_consts
+        self.out = out
+        self.environ_aliases: Set[str] = set()
+
+    def _key_value(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.module_consts.get(node.id)
+        return None
+
+    def _is_environ(self, node: ast.expr) -> bool:
+        name = dotted_name(node)
+        return name in {"os.environ", "environ"} \
+            or (name is not None and name in self.environ_aliases)
+
+    def _flag(self, node, key: str, how: str):
+        if self.sf.suppression(node.lineno, "env-ok",
+                               getattr(node, "end_lineno", None)) is not None:
+            return
+        self.out.append(Violation(
+            self.sf.rel, node.lineno, PASS,
+            f"direct environment read of {key} via {how} — declare a "
+            f"flag in the core/config.py registry and read "
+            f"config.<name> instead"))
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                # any rebinding (to os.environ or anything else) updates
+                # the alias set for the current scope
+                if dotted_name(node.value) == "os.environ":
+                    self.environ_aliases.add(tgt.id)
+                else:
+                    self.environ_aliases.discard(tgt.id)
+        self.generic_visit(node)
+
+    def _visit_scope(self, node):
+        # aliases bound inside a function don't leak into siblings
+        saved = set(self.environ_aliases)
+        self.generic_visit(node)
+        self.environ_aliases = saved
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_Lambda = _visit_scope
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        key = self._key_value(node.args[0]) if node.args else None
+        if key and key.startswith(ENV_PREFIX):
+            if isinstance(func, ast.Attribute) and func.attr == "get" \
+                    and self._is_environ(func.value):
+                self._flag(node, key, "environ.get")
+            elif dotted_name(func) in {"os.getenv", "getenv"}:
+                self._flag(node, key, "os.getenv")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load) and self._is_environ(node.value):
+            key = self._key_value(node.slice)
+            if key and key.startswith(ENV_PREFIX):
+                self._flag(node, key, "environ[...]")
+        self.generic_visit(node)
+
+
+def check_rogue_reads(files: List[SourceFile]) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in files:
+        if sf.rel.replace("\\", "/").endswith(REGISTRY_MODULE):
+            continue
+        module_consts = {
+            tgt.id: stmt.value.value
+            for stmt in sf.tree.body if isinstance(stmt, ast.Assign)
+            for tgt in stmt.targets
+            if isinstance(tgt, ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        }
+        _ReadFinder(sf, module_consts, out).visit(sf.tree)
+    return out
+
+
+def check_duplicates(defs: List[FlagDef]) -> List[Violation]:
+    seen: Dict[str, FlagDef] = {}
+    out = []
+    for d in defs:
+        prev = seen.get(d.name)
+        if prev is not None and (prev.path, prev.line) != (d.path, d.line):
+            out.append(Violation(
+                d.path, d.line, PASS,
+                f"flag '{d.name}' already defined at "
+                f"{prev.path}:{prev.line}"))
+        else:
+            seen[d.name] = d
+    return out
+
+
+def check_completeness(files: List[SourceFile],
+                       defs: List[FlagDef]) -> List[Violation]:
+    declared = {d.env_name for d in defs}
+    out: List[Violation] = []
+    for sf in files:
+        for lineno, line in enumerate(sf.lines, 1):
+            for m in _TOKEN_RE.finditer(line):
+                token = m.group(0)
+                if token in declared or token == ENV_PREFIX:
+                    continue
+                # f-string / startswith prefix construction
+                if token.endswith("_") \
+                        and any(d.startswith(token) for d in declared):
+                    continue
+                if sf.suppression(lineno, "env-ok") is not None:
+                    continue
+                out.append(Violation(
+                    sf.rel, lineno, PASS,
+                    f"{token} is not declared in the config registry "
+                    f"(config.define in core/config.py or the owning "
+                    f"module)"))
+    return out
+
+
+# --------------------------------------------------------------- README table
+
+TABLE_BEGIN = "<!-- env-table:begin (generated by tools/analysis) -->"
+TABLE_END = "<!-- env-table:end -->"
+
+
+def render_table(defs: List[FlagDef]) -> str:
+    rows = ["| Variable | Type | Default | Read | Description |",
+            "|---|---|---|---|---|"]
+    for d in sorted(defs, key=lambda d: d.env_name):
+        default = d.default.replace("|", "\\|")
+        doc = d.doc.replace("|", "\\|")
+        read = "live" if d.live else "startup"
+        rows.append(f"| `{d.env_name}` | {d.type} | `{default}` "
+                    f"| {read} | {doc} |")
+    return "\n".join(rows)
+
+
+def readme_with_table(readme_src: str, defs: List[FlagDef]) -> str:
+    begin = readme_src.index(TABLE_BEGIN)
+    end = readme_src.index(TABLE_END)
+    return (readme_src[:begin + len(TABLE_BEGIN)] + "\n"
+            + render_table(defs) + "\n" + readme_src[end:])
+
+
+def check_readme(readme_path: str, readme_src: str,
+                 defs: List[FlagDef]) -> List[Violation]:
+    if TABLE_BEGIN not in readme_src or TABLE_END not in readme_src:
+        return [Violation(readme_path, 1, PASS,
+                          f"README is missing the generated env-var table "
+                          f"markers ({TABLE_BEGIN!r})")]
+    if readme_with_table(readme_src, defs) != readme_src:
+        return [Violation(
+            readme_path, readme_src[:readme_src.index(TABLE_BEGIN)]
+            .count("\n") + 1, PASS,
+            "env-var table is stale — run "
+            "`python -m tools.analysis --write-env-table`")]
+    return []

@@ -1,0 +1,235 @@
+"""Lock-discipline checker (static half of the concurrency tooling).
+
+Python port of the reference's clang thread-safety annotations
+(``GUARDED_BY`` / ``REQUIRES`` in `src/ray/common/`):
+
+* a field is declared guarded by a trailing ``# guard: <lockname>``
+  comment on its initialization — ``self._x = ...  # guard: _lock`` inside
+  a class, or ``NAME = ...  # guard: _some_lock`` at module level;
+* every later read or write of that field must be lexically inside
+  ``with self.<lockname>:`` (module fields: ``with <lockname>:``), or in a
+  method whose signature carries ``# requires: <lockname>`` — the analog
+  of clang's ``REQUIRES()``, for helpers called with the lock held;
+* calls to a ``# requires:`` method must themselves happen with the lock
+  held (lexically, or from another method requiring the same lock);
+* ``# unguarded-ok: <reason>`` on the access line (or the comment block
+  right above it) suppresses one access — the reason is mandatory.
+
+Scope notes (deliberate, documented limits of the lexical analysis):
+
+* the method that DECLARES a guarded field is exempt (constructors run
+  before the object is shared, same as clang's treatment);
+* code inside a nested ``def``/``lambda`` does NOT inherit the enclosing
+  ``with`` — closures execute later, usually on another thread, which is
+  exactly the bug class this pass exists to catch;
+* only ``self.<field>`` accesses are tracked for class fields (an aliased
+  ``obj._x`` through another name is invisible — keep shared state behind
+  ``self``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analysis.common import (GUARD_RE, REQUIRES_RE, SourceFile,
+                                   Violation)
+
+PASS = "lock-discipline"
+
+
+def _guard_comment(sf: SourceFile, node: ast.stmt) -> Optional[str]:
+    for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+        text = sf.comments.get(line)
+        if text:
+            m = GUARD_RE.search(text)
+            if m:
+                return m.group(1)
+    return None
+
+
+def _assign_targets(node: ast.stmt) -> List[ast.expr]:
+    if isinstance(node, ast.Assign):
+        return node.targets
+    if isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        return [node.target]
+    return []
+
+
+class _FuncChecker(ast.NodeVisitor):
+    """Walks one function body tracking the set of lexically-held locks."""
+
+    def __init__(self, sf: SourceFile, out: List[Violation],
+                 class_guards: Dict[str, str],
+                 module_guards: Dict[str, str],
+                 requires_methods: Dict[str, str],
+                 exempt_fields: Set[str],
+                 held: Set[str]):
+        self.sf = sf
+        self.out = out
+        self.class_guards = class_guards      # field -> lockname (self.*)
+        self.module_guards = module_guards    # global -> lockname
+        self.requires_methods = requires_methods  # method -> lockname
+        self.exempt_fields = exempt_fields
+        self.held = held  # {"self._lock", "_registry_lock", ...}
+
+    # -- lock context -------------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        # context expressions evaluate BEFORE the lock is held: guarded
+        # accesses inside them (e.g. `with self._table[k].lock:`) are
+        # checked against the OUTER held set only
+        for item in node.items:
+            self.visit(item.context_expr)
+        added = []
+        for item in node.items:
+            name = _lock_expr_name(item.context_expr)
+            if name and name not in self.held:
+                self.held.add(name)
+                added.append(name)
+        for stmt in node.body:
+            self.visit(stmt)
+        for name in added:
+            self.held.discard(name)
+
+    visit_AsyncWith = visit_With
+
+    def _enter_closure(self, node):
+        # Closures run later (often on another thread): fresh context.
+        inner = _FuncChecker(self.sf, self.out, self.class_guards,
+                             self.module_guards, self.requires_methods,
+                             self.exempt_fields, set())
+        for child in ast.iter_child_nodes(node):
+            inner.visit(child)
+
+    def visit_FunctionDef(self, node):
+        self._enter_closure(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter_closure(node)
+
+    # -- accesses -----------------------------------------------------------
+
+    def _flag(self, node, what: str, lockname: str, kind: str):
+        sup = self.sf.suppression(node.lineno, "unguarded-ok",
+                                  getattr(node, "end_lineno", None))
+        if sup is not None:
+            return
+        self.out.append(Violation(
+            self.sf.rel, node.lineno, PASS,
+            f"{kind} of {what} (guarded by {lockname}) outside "
+            f"'with {lockname}' — annotate '# unguarded-ok: <reason>' "
+            f"if intentional"))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            lock = self.class_guards.get(node.attr)
+            if lock is not None and node.attr not in self.exempt_fields:
+                if f"self.{lock}" not in self.held:
+                    kind = ("write" if isinstance(node.ctx,
+                                                  (ast.Store, ast.Del))
+                            else "read")
+                    self._flag(node, f"self.{node.attr}", lock, kind)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        lock = self.module_guards.get(node.id)
+        if lock is not None and lock not in self.held:
+            kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read")
+            self._flag(node, node.id, lock, kind)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # calls into `# requires:` methods need the lock at the call site
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            lock = self.requires_methods.get(func.attr)
+            if lock is not None and f"self.{lock}" not in self.held:
+                sup = self.sf.suppression(node.lineno, "unguarded-ok",
+                                          node.end_lineno)
+                if sup is None:
+                    self.out.append(Violation(
+                        self.sf.rel, node.lineno, PASS,
+                        f"call to self.{func.attr}() which `# requires: "
+                        f"{lock}` without holding 'with self.{lock}'"))
+        self.generic_visit(node)
+
+
+def _lock_expr_name(expr: ast.expr) -> Optional[str]:
+    """'self._lock' / '_registry_lock' for a with-item, else None."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return f"{expr.value.id}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _collect_class(sf: SourceFile, cls: ast.ClassDef) \
+        -> Tuple[Dict[str, str], Dict[str, str], Dict[str, Set[str]]]:
+    """(field -> lock, method -> required lock, field -> declaring methods)"""
+    guards: Dict[str, str] = {}
+    requires: Dict[str, str] = {}
+    declared_in: Dict[str, Set[str]] = {}
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        req = sf.signature_comment(item, REQUIRES_RE)
+        if req:
+            requires[item.name] = req
+        for stmt in ast.walk(item):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = _guard_comment(sf, stmt)
+            if not lock:
+                continue
+            for tgt in _assign_targets(stmt):
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    guards[tgt.attr] = lock
+                    declared_in.setdefault(tgt.attr, set()).add(item.name)
+    return guards, requires, declared_in
+
+
+def check(sf: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+
+    # module-level guarded globals
+    module_guards: Dict[str, str] = {}
+    for stmt in sf.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            lock = _guard_comment(sf, stmt)
+            if not lock:
+                continue
+            for tgt in _assign_targets(stmt):
+                if isinstance(tgt, ast.Name):
+                    module_guards[tgt.id] = lock
+
+    def check_function(fn, class_guards, requires, declared_in):
+        held: Set[str] = set()
+        req = sf.signature_comment(fn, REQUIRES_RE)
+        if req:
+            held.add(f"self.{req}")
+            held.add(req)
+        exempt = {field for field, methods in declared_in.items()
+                  if fn.name in methods}
+        checker = _FuncChecker(sf, out, class_guards, module_guards,
+                               requires, exempt, held)
+        for child in ast.iter_child_nodes(fn):
+            checker.visit(child)
+
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            guards, requires, declared_in = _collect_class(sf, stmt)
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    check_function(item, guards, requires, declared_in)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            check_function(stmt, {}, {}, {})
+
+    return out

@@ -1,0 +1,46 @@
+"""Thread-hygiene checker.
+
+Every ``threading.Thread(...)`` construction in the runtime must
+
+* pass ``name=`` — anonymous ``Thread-12`` in a stack dump of a wedged
+  raylet is useless, and the DebugLock watchdog reports thread names; and
+* either pass ``daemon=True`` (the process must never hang on exit
+  because a background pump is still parked in ``recv``) or be registered
+  with a shutdown joiner, declared via ``# joined-by: <who joins it>`` on
+  the construction line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analysis.common import SourceFile, Violation, dotted_name
+
+PASS = "thread-hygiene"
+
+
+def check(sf: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name not in {"threading.Thread", "Thread"}:
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if "name" not in kwargs:
+            out.append(Violation(
+                sf.rel, node.lineno, PASS,
+                "threading.Thread(...) without name= — give every "
+                "runtime thread a stable name"))
+        daemon = kwargs.get("daemon")
+        is_daemon = isinstance(daemon, ast.Constant) and daemon.value is True
+        if not is_daemon and sf.suppression(node.lineno, "joined-by",
+                                            node.end_lineno) is None:
+            out.append(Violation(
+                sf.rel, node.lineno, PASS,
+                "threading.Thread(...) is neither daemon=True nor "
+                "registered with a shutdown joiner "
+                "('# joined-by: <who joins it>')"))
+    return out
