@@ -166,10 +166,13 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     raise TypeError(f"get() got {type(refs)}")
 
 
-def put(value: Any) -> ObjectRef:
+def put(value: Any, *, _replicate: bool = False) -> ObjectRef:
+    """``_replicate=True`` eagerly pushes a secondary copy of the object
+    to another node (cheap availability: losing the holder then costs a
+    pull from the replica, not a lineage recompute)."""
     if isinstance(value, ObjectRef):
         raise TypeError("put() of an ObjectRef is not allowed")
-    return global_worker().put(value)
+    return global_worker().put(value, _replicate=_replicate)
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,

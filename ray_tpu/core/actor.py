@@ -80,6 +80,7 @@ class ActorHandle:
             num_returns=num_returns,
             actor_id=self._actor_id,
             method_name=method_name,
+            replicate=bool(opts.get("_replicate", False)),
             concurrency_group=(opts.get("concurrency_group")
                                or self._method_groups.get(method_name)),
         )
@@ -169,6 +170,27 @@ class ActorClass:
         else:
             concurrency_groups = None
             total_concurrency = declared_conc
+        # Checkpointable actors (reference: Ray actor checkpointing
+        # lineage, SURVEY §5): opt-in protocol — the class defines
+        # __ray_save__(self) -> state and __ray_restore__(self, state);
+        # the worker snapshots every `checkpoint_interval` completed
+        # calls and a restart restores from the latest snapshot instead
+        # of starting cold.
+        checkpoint_interval = int(opts.get("checkpoint_interval", 0) or 0)
+        if checkpoint_interval < 0:
+            raise ValueError("checkpoint_interval must be >= 0")
+        if checkpoint_interval:
+            for proto in ("__ray_save__", "__ray_restore__"):
+                if not callable(getattr(self._cls, proto, None)):
+                    raise TypeError(
+                        f"checkpoint_interval requires the actor class to "
+                        f"define {proto}")
+            if groups or declared_conc > 1:
+                # a snapshot taken while other threads mutate the instance
+                # would tear state — checkpointing is sync-actor only
+                raise ValueError(
+                    "checkpoint_interval requires a plain sync actor "
+                    "(max_concurrency=1, no concurrency groups)")
         placement = _placement_from_opts(opts) or {}
         if opts.get("name"):
             placement["name"] = opts["name"]
@@ -186,6 +208,7 @@ class ActorClass:
             resources=_build_resources(opts),
             max_restarts=max_restarts,
             max_concurrency=total_concurrency,
+            checkpoint_interval=checkpoint_interval,
             concurrency_groups=concurrency_groups,
             method_groups=method_groups,
             actor_id=actor_id,

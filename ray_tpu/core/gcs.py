@@ -333,6 +333,7 @@ class GcsCore:
             # that scrub valid holders).
             for oid, entry in list(self._objects.items()):
                 entry["nodes"].discard(node_id)
+                entry.get("replicas", set()).discard(node_id)
                 if not entry["nodes"]:
                     del self._objects[oid]
         self._publish("node_dead", {"node_id": node_id, "reason": reason})
@@ -773,7 +774,13 @@ class GcsCore:
             return True
 
     def update_actor(self, actor_id: bytes, state: str,
-                     node_id: Optional[str] = None):
+                     node_id: Optional[str] = None,
+                     checkpoint: Optional[str] = None,
+                     checkpoint_seq: Optional[int] = None):
+        """``checkpoint``/``checkpoint_seq``: latest checkpoint object id
+        (hex) + sequence number of a checkpointable actor — the actor
+        table tracks the freshest snapshot so state tooling can see what
+        a restart would restore from."""
         with self._lock:
             info = self._actors.get(actor_id)
             if info is None:
@@ -781,6 +788,9 @@ class GcsCore:
             info["state"] = state
             if node_id is not None:
                 info["exec_node"] = node_id
+            if checkpoint is not None:
+                info["checkpoint"] = checkpoint
+                info["checkpoint_seq"] = checkpoint_seq or 0
             self._mark_dirty()
 
     def remove_actor(self, actor_id: bytes):
@@ -816,13 +826,21 @@ class GcsCore:
     # ----------------------------------------------------------- objects
 
     def add_object_location(self, oid: str, node_id: str, size: int = 0,
-                            inline: bool = False):
+                            inline: bool = False, replica: bool = False):
+        """``replica``: this holder is an eager secondary copy (pushed by
+        the sealing raylet for availability, not pulled by a consumer) —
+        recorded so re-replication math can tell managed copies from
+        incidental consumer-side caches.  Striping treats all holders the
+        same, so every replica also doubles a pull's read bandwidth."""
         with self._lock:
             entry = self._objects.setdefault(
-                oid, {"nodes": set(), "size": size, "inline": inline})
+                oid, {"nodes": set(), "size": size, "inline": inline,
+                      "replicas": set()})
             entry["nodes"].add(node_id)
             entry["size"] = max(entry["size"], size)
             entry["inline"] = entry["inline"] or inline
+            if replica:
+                entry.setdefault("replicas", set()).add(node_id)
             push_size, push_inline = entry["size"], entry["inline"]
             watchers = self._object_watchers.pop(oid, set())
         for w in watchers:
@@ -839,6 +857,7 @@ class GcsCore:
             entry = self._objects.get(oid)
             if entry:
                 entry["nodes"].discard(node_id)
+                entry.get("replicas", set()).discard(node_id)
                 if not entry["nodes"]:
                     del self._objects[oid]
 
@@ -852,10 +871,28 @@ class GcsCore:
             entry = self._objects.get(oid)
             if entry and entry["nodes"]:
                 return {"nodes": sorted(entry["nodes"]),
-                        "size": entry["size"], "inline": entry["inline"]}
+                        "size": entry["size"], "inline": entry["inline"],
+                        "replicas": sorted(entry.get("replicas", ()))}
             if watcher is not None:
                 self._object_watchers.setdefault(oid, set()).add(watcher)
             return {"nodes": [], "size": 0, "inline": False}
+
+    def get_object_locations_batch(self, oids: List[str]) -> Dict[str, dict]:
+        """One round trip for many objects (node-death recovery scans a
+        dead node's whole holding set — per-object RPCs would serialize a
+        raylet's event thread on GCS latency).  Objects with no known
+        holder are simply absent from the result; no watches are
+        registered."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for oid in oids:
+                entry = self._objects.get(oid)
+                if entry and entry["nodes"]:
+                    out[oid] = {
+                        "nodes": sorted(entry["nodes"]),
+                        "size": entry["size"], "inline": entry["inline"],
+                        "replicas": sorted(entry.get("replicas", ()))}
+            return out
 
     # ----------------------------------------------------------- task events
 
@@ -969,6 +1006,7 @@ _OPS = {
     "register_actor", "update_actor", "remove_actor", "get_actor",
     "lookup_named_actor", "list_actors",
     "add_object_location", "remove_object_location", "get_object_locations",
+    "get_object_locations_batch",
     "create_pg", "pg_fragment_ready", "remove_cluster_pg", "pg_info",
     "add_task_events", "list_task_events", "task_events_raw",
     "summarize_task_events",

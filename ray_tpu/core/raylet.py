@@ -107,6 +107,31 @@ config.define("pull_sender_threads", int, 2,
               "behind these threads instead of spawning one thread per "
               "request; saturation is counted in "
               "ray_tpu_internal_pull_sender_saturated_total.")
+config.define("replication_min_bytes", int, 0,
+              "Eager availability (reference: secondary object copies, "
+              "SURVEY §5 failure recovery): a store object sealed at or "
+              "above this size on its producing node is immediately pushed "
+              "to a second node over the data plane, so losing the holder "
+              "costs a pull from the replica instead of a lineage "
+              "recompute (and striping across both holders doubles read "
+              "bandwidth).  0 disables the auto-threshold; explicitly "
+              "flagged objects (put(..., _replicate=True) / the "
+              "_replicate task option) and actor checkpoints replicate "
+              "regardless.")
+config.define("replication_factor", int, 2,
+              "Total copies (primary included) eager replication creates "
+              "and re-replication maintains after a holder dies.")
+config.define("replication_verify_delay_s", float, 10.0,
+              "Replication pushes are fire-and-forget; this long after a "
+              "push round the producer re-checks the directory and "
+              "re-pushes if targets never registered their copy (dead "
+              "target, store-less node, abandoned pull).  Up to 2 "
+              "re-push rounds per object.")
+config.define("kill_checkpoint_grace_s", float, 10.0,
+              "kill(actor, no_restart=False) on a checkpointable actor "
+              "asks the worker for a final checkpoint + graceful exit; "
+              "if the worker has not exited after this grace (wedged "
+              "call, deep queue) it is SIGKILLed like a hard kill.")
 config.define("locality_aware_min_bytes", int, 1 << 20,
               "Locality-aware placement (reference: locality_aware lease "
               "policy): a task whose remote arguments hold at least this "
@@ -203,7 +228,8 @@ class _ObjectState:
     __slots__ = ("status", "value", "error", "size", "locations",
                  "holders", "pins", "tracked", "creating_spec",
                  "free_armed", "contains", "remote_inline",
-                 "recon_attempts", "lookup_attempts")
+                 "recon_attempts", "lookup_attempts",
+                 "replicated", "replicas")
 
     def __init__(self):
         # pending | inline | store | remote | error
@@ -236,6 +262,12 @@ class _ObjectState:
         # Consecutive failed directory re-lookups — drives the unified
         # backoff on pull retries; reset when the object materializes.
         self.lookup_attempts = 0
+        # Eager availability: True on every node that holds a MANAGED copy
+        # (the producer that pushed replicas, or a replica holder) — these
+        # nodes re-replicate when a holder dies.  ``replicas`` lists the
+        # nodes this raylet pushed copies to (producer side only).
+        self.replicated = False
+        self.replicas: Optional[List[str]] = None
 
 
 class _PeerConn:
@@ -291,6 +323,12 @@ class _ActorState:
             getattr(spec, "concurrency_groups", None)
         self.restarts_left = spec.max_restarts
         self.death_reason = ""
+        # Checkpointable actors: latest snapshot object (pinned by the
+        # raylet until superseded or the actor is finally dead) + its
+        # monotonic sequence number (relayed checkpoints can arrive out
+        # of order around a restart).
+        self.checkpoint_oid: Optional[ObjectID] = None
+        self.checkpoint_seq = 0
         # Sync plain actors (max_concurrency 1, no groups, non-asyncio —
         # reported by the creation-done message) execute calls one at a
         # time on the worker's main thread, so pipelining calls ahead of
@@ -553,6 +591,15 @@ class Raylet:
         self._m_recon_attempts = 0
         self._m_recon_successes = 0
         self._m_recon_failures = 0
+        # Eager replication / actor checkpointing (cheap availability)
+        self._replicating: set = set()  # oids being pulled as replicas here
+        self._m_repl_pushes = 0      # replica pushes initiated
+        self._m_repl_bytes = 0       # bytes covered by those pushes
+        self._m_repl_repairs = 0     # re-replications after a holder died
+        self._m_repl_recoveries = 0  # node-death losses served by a replica
+        self._m_ckpt_saves = 0       # actor checkpoints recorded
+        self._m_ckpt_bytes = 0
+        self._m_ckpt_restores = 0    # restarts that restored from one
         # Unified jittered-exponential backoff for transient-failure paths
         # (GCS reconnect, pull re-lookups; data-channel dials hold their
         # own instance inside the pull manager).
@@ -1180,6 +1227,8 @@ class Raylet:
             self._schedule()
         elif t == "stream_item":
             self._on_stream_item(msg)
+        elif t == "checkpoint":
+            self._on_actor_checkpoint(conn, msg)
         elif t == "ref_events":
             self.apply_ref_events(msg["events"], conn)
 
@@ -1226,6 +1275,9 @@ class Raylet:
                     self._obj(oid).size = sizes.get(hex_id, 0)
                     self._object_in_store(oid,
                                           contains=contains.get(hex_id))
+                    # eager availability: push a secondary copy of a big
+                    # (or explicitly flagged) result while it is hot
+                    self._maybe_replicate(oid, force=spec.replicate)
                 self._record_event(spec, "FINISHED")
         # worker back to pool / actor next call
         if spec.kind == ACTOR_CREATION_TASK:
@@ -1505,14 +1557,63 @@ class Raylet:
                 st.locations.remove(node_id)
             if not st.locations:
                 lost.append(oid)
+        # Eager availability: consult the directory for surviving copies
+        # (replicas, or holders this raylet never heard of) BEFORE
+        # falling into recompute — the GCS pruned the dead node
+        # synchronously ahead of the node_dead push, so a hit here is a
+        # live copy and recovery is a pull, not a re-run.  ONE batched
+        # query: a dead node can take thousands of sole copies with it,
+        # and per-object RPCs would serialize this thread on GCS latency.
+        locs = None
+        if lost:
+            res = self._gcs_err_ok(self.gcs.get_object_locations_batch,
+                                   [o.hex() for o in lost])
+            if res is not _GCS_ERR:
+                locs = res or {}
         for oid in lost:
             st = self._objects.get(oid)
             if st is None or st.status != "remote" or st.locations:
                 continue  # a sibling's reconstruction already reset it
+            loc = locs.get(oid.hex()) if locs is not None else None
+            if loc:
+                nodes = [n for n in loc["nodes"]
+                         if n != self.node_id and n != node_id
+                         and n in self._cluster_nodes]
+                if nodes:
+                    st.locations = nodes
+                    st.size = max(st.size, loc.get("size", 0))
+                    self._m_repl_recoveries += 1
+                    if (oid in self._object_waiters
+                            or oid in self._dep_index):
+                        self._maybe_pull(oid)
+                    continue
             if self.reconstruct_object(oid):
                 continue
             self._object_error(oid, self._lost_error(
                 oid, st, f"was on node {node_id} which died"))
+        # Re-replication: local managed copies whose peer holder died —
+        # restore the target copy count so the NEXT death is still a pull.
+        repair: List[Tuple[ObjectID, "_ObjectState"]] = []
+        for oid, st in list(self._objects.items()):
+            if st.status != "store" or not st.replicated:
+                continue
+            if (node_id not in (st.replicas or ())
+                    and node_id not in st.locations):
+                continue
+            if st.replicas and node_id in st.replicas:
+                st.replicas.remove(node_id)
+            if node_id in st.locations:
+                st.locations.remove(node_id)
+            repair.append((oid, st))
+        if repair:
+            res = self._gcs_err_ok(self.gcs.get_object_locations_batch,
+                                   [o.hex() for o, _ in repair])
+            if res is not _GCS_ERR:  # transient GCS trouble: best-effort
+                for oid, st in repair:
+                    loc = (res or {}).get(oid.hex()) or {}
+                    if self._repair_replication(oid, st, loc,
+                                                dead=node_id):
+                        self._m_repl_repairs += 1
         # Actors executing on the dead node: restart per budget.
         for actor in list(self._actors.values()):
             if actor.node_id == node_id and actor.state != "dead":
@@ -1603,6 +1704,12 @@ class Raylet:
             self._handle_pull_chunk(msg)
         elif t == "pull_err":
             self._handle_pull_err(msg)
+        elif t == "xreplicate":
+            self._handle_xreplicate(msg)
+        elif t == "xreplica_drop":
+            self._handle_xreplica_drop(msg)
+        elif t == "xcheckpoint":
+            self._handle_xcheckpoint(msg)
 
     # ---- task forwarding (spillback / actor routing) ----
 
@@ -2100,7 +2207,10 @@ class Raylet:
                 st.locations.remove(node)
             self._gcs_post("remove_object_location", oid.hex(), node)
         if oid not in self._object_waiters and oid not in self._dep_index:
-            return  # nobody is waiting anymore
+            # nobody is waiting anymore; an abandoned replication pull
+            # must drop its marker too (best-effort, no retry)
+            self._replicating.discard(oid)
+            return
         if st.locations:
             self._maybe_pull(oid)
             return
@@ -2234,6 +2344,17 @@ class Raylet:
                     self._maybe_free(inner)
         if self.cluster_mode:
             self._gcs_post("remove_object_location", oid.hex(), self.node_id)
+        if st.replicas:
+            # the primary is gone for good: managed secondaries must not
+            # outlive it (they hold no refs of their own)
+            for node in st.replicas:
+                peer = self._get_peer(node)
+                if peer is None:
+                    continue
+                try:
+                    peer.send({"t": "xreplica_drop", "id": oid.hex()})
+                except OSError:
+                    self._drop_peer(peer)
 
     def _maybe_free(self, oid: ObjectID):
         st = self._objects.get(oid)
@@ -2465,6 +2586,268 @@ class Raylet:
             self._m_recon_successes += 1
             self._record_event(spec, "RECONSTRUCTED")
 
+    # ------------------------------------------- eager replication
+    # (cheap availability: recovery should be a copy, not a recompute —
+    # reference: secondary object copies, SURVEY §3 object manager / §5
+    # failure recovery.  The push rides the PR 4 data plane: the producer
+    # asks the target to PULL, so striping/admission/failover all reuse
+    # the pull manager.)
+
+    def _maybe_replicate(self, oid: ObjectID, force: bool = False):
+        """Push secondary copies of a locally sealed store object when it
+        crosses the auto-threshold (RAY_TPU_REPLICATION_MIN_BYTES) or was
+        explicitly flagged (``force``: _replicate option / checkpoint)."""
+        if not self.cluster_mode:
+            return
+        st = self._objects.get(oid)
+        if st is None or st.status != "store" or st.replicated:
+            return
+        thresh = config.replication_min_bytes
+        if not force and (thresh <= 0 or (st.size or 0) < thresh):
+            return
+        self._replicate_object(oid, st,
+                               max(1, config.replication_factor) - 1)
+
+    def _replicate_object(self, oid: ObjectID, st: "_ObjectState",
+                          count: int, exclude=(), attempt: int = 0) -> int:
+        """Ask up to ``count`` live peers (none of which hold the object)
+        to pull a copy from this node.  Pushes are fire-and-forget, so a
+        delayed verify pass re-checks the directory and re-pushes when a
+        target never registered its copy (died mid-pull, store-less,
+        abandoned pull) — without it a silently failed push would leave
+        the object unprotected forever while marked replicated."""
+        if count <= 0:
+            return 0
+        have = {self.node_id} | set(st.locations) \
+            | set(st.replicas or ()) | set(exclude)
+        cands = [n for n, info in self._cluster_nodes.items()
+                 if n not in have and info.get("alive", True)
+                 # a node registered WITHOUT a store can't hold a replica
+                 # (node_added pushes lack the key: treat unknown as ok)
+                 and (info.get("store_path") or "store_path" not in info)]
+        if not cands:
+            return 0
+        random.shuffle(cands)
+        sent = 0
+        for target in cands:
+            if sent >= count:
+                break
+            peer = self._get_peer(target)
+            if peer is None:
+                continue
+            try:
+                peer.send({"t": "xreplicate", "id": oid.hex(),
+                           "size": st.size or 0, "src": self.node_id})
+            except OSError:
+                self._drop_peer(peer)
+                continue
+            if st.replicas is None:
+                st.replicas = []
+            st.replicas.append(target)
+            sent += 1
+            self._m_repl_pushes += 1
+            self._m_repl_bytes += st.size or 0
+        if sent:
+            st.replicated = True
+            if attempt < 2:
+                self.add_timer(
+                    max(0.5, config.replication_verify_delay_s),
+                    lambda: self._verify_replication(oid, attempt + 1))
+        return sent
+
+    def _verify_replication(self, oid: ObjectID, attempt: int):
+        """Delayed confirmation of a push round: targets that never
+        registered their copy are scrubbed and replaced (bounded
+        rounds).  An extra copy from a slow-but-successful pull racing
+        the verify is tolerated — over-replication wastes a little
+        store space, under-replication breaks the availability story."""
+        st = self._objects.get(oid)
+        if st is None or st.status != "store" or not st.replicated:
+            return
+        loc = self._gcs_err_ok(self.gcs.get_object_locations, oid.hex())
+        if loc is _GCS_ERR:
+            return
+        registered = set((loc or {}).get("replicas", ()))
+        st.replicas = sorted(registered - {self.node_id})
+        self._repair_replication(oid, st, loc or {}, attempt=attempt)
+
+    def _repair_replication(self, oid: ObjectID, st: "_ObjectState",
+                            loc: dict, dead: Optional[str] = None,
+                            attempt: int = 0) -> int:
+        """Push enough fresh copies to restore the target count.  The
+        deficit counts MANAGED copies only (directory ``replicas`` plus
+        this primary): incidental consumer-side caches in ``nodes`` are
+        transient, and counting them as durable copies would silently
+        skip the repair right until they evict.  Current holders (caches
+        included) are still excluded as push TARGETS — they already
+        have the bytes."""
+        nodes = set(loc.get("nodes", ()))
+        managed = set(loc.get("replicas", ())) | {self.node_id}
+        if dead is not None:
+            managed.discard(dead)
+        deficit = max(1, config.replication_factor) - len(managed)
+        if deficit <= 0:
+            return 0
+        return self._replicate_object(oid, st, deficit, exclude=nodes,
+                                      attempt=attempt)
+
+    def _handle_xreplicate(self, msg: dict):
+        """A peer sealed an object and wants a secondary copy here: pull
+        it through the normal machinery (data plane when available).  The
+        seal path marks the copy as a replica (``_replicating``)."""
+        if not self.store_path:
+            return  # store-less node: nowhere to hold a replica
+        oid = ObjectID.from_hex(msg["id"])
+        st = self._obj(oid)
+        if st.status in ("inline", "store", "error"):
+            return  # already local (or failed): nothing to do
+        self._replicating.add(oid)
+        src = msg.get("src")
+        if src and src not in st.locations:
+            st.locations.append(src)
+        st.size = max(st.size, msg.get("size", 0))
+        if st.status == "pending":
+            st.status = "remote"
+        self._maybe_pull(oid)
+
+    def _handle_xreplica_drop(self, msg: dict):
+        """The producer freed the primary: drop the managed replica —
+        unless local work picked up references to it in the meantime, in
+        which case it demotes to an ordinary refcounted entry."""
+        oid = ObjectID.from_hex(msg["id"])
+        st = self._objects.get(oid)
+        if st is None:
+            return
+        if (st.holders > 0 or st.pins > 0 or oid in self._dep_index
+                or oid in self._object_waiters):
+            st.replicated = False
+            return
+        self.drop_object(oid)
+
+    # --------------------------------------------- actor checkpoints
+
+    def _on_actor_checkpoint(self, conn: _WorkerConn, msg: dict):
+        """A checkpointable actor's worker snapshotted its state: seal the
+        checkpoint object here, replicate it, and record it on the actor
+        (relaying to the owner when the actor executes here for another
+        raylet)."""
+        oid = ObjectID.from_hex(msg["id"])
+        inline = msg.get("inline")
+        actor = (self._actors.get(conn.actor_id)
+                 if conn.actor_id is not None else None)
+        if actor is None or actor.conn is not conn:
+            # Stale (buffered bytes from a conn whose actor already died
+            # or restarted elsewhere): REJECT before sealing — a sealed
+            # checkpoint nobody records would never be pinned, tracked,
+            # or dropped, leaking its store bytes plus cluster replicas.
+            if inline is None:
+                store = self._raylet_store()
+                if store is not None:
+                    try:
+                        store.delete(oid)  # scrub the dead worker's bytes
+                    except Exception:  # noqa: BLE001
+                        pass
+            return
+        if inline is not None:
+            self._object_inline(oid, inline)
+        else:
+            st = self._obj(oid)
+            st.size = max(st.size, msg.get("size", 0))
+            self._object_in_store(oid)
+            # checkpoints are the canonical "hot state worth a copy":
+            # replicate regardless of the size threshold
+            self._maybe_replicate(oid, force=True)
+        if actor.foreign_owner is not None:
+            # Exec side of a forwarded actor: the owner runs the restart
+            # machine — ship the checkpoint ref (and the blob for inline
+            # ones) to it; store checkpoints advertise this holder.  The
+            # exec side ALSO records the snapshot locally (publish=False):
+            # without the pin/track/supersede cycle every superseded
+            # checkpoint object sealed here (plus its forced replicas)
+            # would leak — only tracked entries ever free, and only the
+            # primary's teardown drops replicas.
+            self._set_actor_checkpoint(actor, oid, msg["seq"],
+                                       publish=False)
+            peer = self._get_peer(actor.foreign_owner)
+            if peer is not None:
+                try:
+                    peer.send({"t": "xcheckpoint",
+                               "actor_id": actor.actor_id,
+                               "seq": msg["seq"], "id": msg["id"],
+                               "inline": inline,
+                               "size": msg.get("size", 0),
+                               "node": self.node_id})
+                except OSError:
+                    self._drop_peer(peer)
+            return
+        self._set_actor_checkpoint(actor, oid, msg["seq"])
+
+    def _handle_xcheckpoint(self, msg: dict):
+        """Owner side: a forwarded actor checkpointed on its exec node.
+        Staleness check FIRST (a relay from a node the actor already
+        moved off): sealing or registering a checkpoint nobody records
+        would leak an untracked, unpinned entry — the same hazard the
+        exec-side stale path rejects before sealing."""
+        actor = self._actors.get(msg["actor_id"])
+        if actor is None or actor.node_id != msg.get("node"):
+            return
+        oid = ObjectID.from_hex(msg["id"])
+        if msg.get("inline") is not None:
+            self._object_inline(oid, msg["inline"])
+        else:
+            st = self._obj(oid)
+            if msg.get("node") and msg["node"] not in st.locations:
+                st.locations.append(msg["node"])
+            st.size = max(st.size, msg.get("size", 0))
+            if st.status == "pending":
+                st.status = "remote"
+            # keep a local copy too: the restart usually lands here, and
+            # the exec node (the likeliest casualty) must not hold the
+            # only bytes
+            self._maybe_pull(oid)
+        self._set_actor_checkpoint(actor, oid, msg["seq"])
+
+    def _set_actor_checkpoint(self, actor: "_ActorState", oid: ObjectID,
+                              seq: int, publish: bool = True):
+        """Record the freshest checkpoint (callers already rejected stale
+        sources by conn/node identity; ``seq`` is the worker's own count,
+        kept for observability — the owner's counter is what orders
+        snapshots across restarts).  ``publish=False`` on the exec side
+        of a forwarded actor: pin/supersede locally, but the OWNER owns
+        the GCS actor-table entry and the restart machine."""
+        prev = actor.checkpoint_oid
+        actor.checkpoint_oid = oid
+        actor.checkpoint_seq += 1
+        st = self._obj(oid)
+        st.pins += 1        # the raylet holds the latest checkpoint
+        st.tracked = True   # ...and superseded ones become freeable
+        if publish:
+            # owner-side only: the cluster-wide sum stays one per
+            # snapshot even when exec + owner both record it
+            self._m_ckpt_saves += 1
+            self._m_ckpt_bytes += st.size or len(st.value or b"")
+        if publish and self.cluster_mode:
+            self._gcs_post("update_actor", actor.actor_id.binary(),
+                           "alive", checkpoint=oid.hex(),
+                           checkpoint_seq=actor.checkpoint_seq)
+        if prev is not None and prev != oid:
+            pst = self._objects.get(prev)
+            if pst is not None:
+                pst.pins -= 1
+                self._maybe_free(prev)
+
+    def _release_actor_checkpoint(self, actor: "_ActorState"):
+        """Final actor death: the raylet's pin on the last checkpoint is
+        released so it can free like any other unreferenced object."""
+        oid = actor.checkpoint_oid
+        if oid is None:
+            return
+        actor.checkpoint_oid = None
+        st = self._objects.get(oid)
+        if st is not None:
+            st.pins -= 1
+            self._maybe_free(oid)
+
     # --------------------------------------------------------------- streams
 
     def _init_stream(self, spec: TaskSpec):
@@ -2633,9 +3016,16 @@ class Raylet:
         st = self._obj(oid)
         st.status = "store"
         self._set_contains(st, contains)
+        replica = oid in self._replicating
+        if replica:
+            # This seal completed an eager-replication pull: mark the copy
+            # managed (this node re-replicates on holder death) and tell
+            # the directory it is a secondary.
+            self._replicating.discard(oid)
+            st.replicated = True
         if self.cluster_mode:
             self._gcs_post("add_object_location", oid.hex(),
-                           self.node_id, st.size)
+                           self.node_id, st.size, replica=replica)
         self._object_ready(oid)
 
     def _object_error(self, oid: ObjectID, err: Exception):
@@ -2856,6 +3246,12 @@ class Raylet:
         for oid in spec.dependency_ids():
             st = self._objects.get(oid)
             if st is not None and st.status == "error":
+                if spec.restore_oid is not None and oid == spec.restore_oid:
+                    # an unrecoverable CHECKPOINT must not kill the actor:
+                    # fall back to a cold start (the cost checkpointing
+                    # exists to avoid, but strictly better than dead)
+                    spec.restore_oid = None
+                    continue
                 for rid in spec.return_ids():
                     self._object_error(rid, st.error)
                 self._record_event(spec, "FAILED", dep_error=True)
@@ -3420,6 +3816,14 @@ class Raylet:
             creation._acquired_pool = None
             creation._spill_count = 0
             actor.node_id = None
+            # Checkpointable actors restart WARM: the creation re-runs
+            # __init__ and then __ray_restore__(latest __ray_save__ state)
+            # — calls completed after that snapshot are NOT replayed
+            # (their side effects since it are lost; callers saw their
+            # results and the interrupted tail got a retryable error).
+            if actor.checkpoint_oid is not None:
+                creation.restore_oid = actor.checkpoint_oid
+                self._m_ckpt_restores += 1
             if self.cluster_mode and actor.foreign_owner is None:
                 self._gcs_post("update_actor", actor_id.binary(),
                                "restarting")
@@ -3429,6 +3833,7 @@ class Raylet:
             return
         actor.state = "dead"
         actor.death_reason = reason
+        self._release_actor_checkpoint(actor)
         err = ActorDiedError(actor_id.hex(), reason)
         for spec in interrupted:
             for oid in spec.return_ids():
@@ -3482,12 +3887,15 @@ class Raylet:
             actor.restarts_left = 0
         if actor.node_id is not None and actor.node_id != self.node_id:
             # executing on a peer: kill there; death flows back as
-            # xactor_death
+            # xactor_death.  Relay no_restart AS GIVEN: the exec side
+            # never restarts regardless (foreign actors carry
+            # restarts_left=0) but a restart-allowed kill must reach it
+            # so a checkpointable actor can take its final snapshot.
             peer = self._get_peer(actor.node_id)
             if peer is not None:
                 try:
                     peer.send({"t": "xkill", "actor_id": actor_id,
-                               "no_restart": True})
+                               "no_restart": no_restart})
                     return
                 except OSError:
                     self._drop_peer(peer)
@@ -3498,6 +3906,35 @@ class Raylet:
             return
         conn = actor.conn
         if conn is not None and conn.pid:
+            if (not no_restart
+                    and actor.creation_spec.checkpoint_interval > 0):
+                # Restart-allowed kill of a checkpointable actor: distinct
+                # from hard kill — ask the worker to take a FINAL
+                # checkpoint and exit, so the restart restores the exact
+                # pre-kill state instead of whatever the last cadence
+                # snapshot happened to hold.  (Previously this routed
+                # through the same SIGKILL as no_restart=True.)  The
+                # request drains behind queued calls, so a wedged or
+                # slow actor gets the hard kill after a grace — kill()
+                # must never silently become a no-op.
+                try:
+                    conn.send({"t": "exit_checkpoint"})
+                except OSError:
+                    pass  # fall through to the hard kill
+                else:
+                    def force(conn=conn, pid=conn.pid,
+                              actor_id=actor.actor_id):
+                        live = self._actors.get(actor_id)
+                        if live is None or live.conn is not conn:
+                            return  # exited gracefully (or restarted)
+                        try:
+                            os.kill(pid, 9)
+                        except OSError:
+                            pass
+
+                    self.add_timer(
+                        max(0.1, config.kill_checkpoint_grace_s), force)
+                    return  # EOF after the final checkpoint drives restart
             try:
                 os.kill(conn.pid, 9)
             except OSError:
@@ -3572,6 +4009,8 @@ class Raylet:
                 if "size" in msg:
                     self._obj(oid).size = msg["size"]
                 self._object_in_store(oid, contains=msg.get("contains"))
+                self._maybe_replicate(oid,
+                                      force=msg.get("replicate", False))
                 reply()
             elif op == "kv_put":
                 self.gcs.kv_put(msg["ns"], msg["key"], msg["val"])
@@ -4120,6 +4559,31 @@ class Raylet:
                 "Recursion depth at which reconstructions were started "
                 "(dependency chains re-run below the lost object)",
                 (1, 2, 4, 8)),
+            # ---- eager availability (replication + actor checkpoints) ----
+            "repl_pushes": counter(
+                "ray_tpu_internal_replication_pushes_total",
+                "Secondary-copy pushes initiated for sealed objects"),
+            "repl_bytes": counter(
+                "ray_tpu_internal_replication_bytes_total",
+                "Object bytes covered by replication pushes"),
+            "repl_repairs": counter(
+                "ray_tpu_internal_replication_repairs_total",
+                "Re-replications after a holder died (copy count "
+                "restored)"),
+            "repl_recoveries": counter(
+                "ray_tpu_internal_replication_recoveries_total",
+                "Node-death object losses recovered from a surviving "
+                "copy instead of lineage recompute"),
+            "ckpt_saves": counter(
+                "ray_tpu_internal_checkpoint_saves_total",
+                "Actor state checkpoints recorded"),
+            "ckpt_bytes": counter(
+                "ray_tpu_internal_checkpoint_bytes_total",
+                "Serialized actor checkpoint bytes recorded"),
+            "ckpt_restores": counter(
+                "ray_tpu_internal_checkpoint_restores_total",
+                "Actor restarts that restored from a checkpoint instead "
+                "of starting cold"),
         }
         self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
         if isinstance(self.gcs, GcsClient):
@@ -4189,6 +4653,13 @@ class Raylet:
         bump(im["recon_attempts"], "recon_att", self._m_recon_attempts)
         bump(im["recon_successes"], "recon_ok", self._m_recon_successes)
         bump(im["recon_failures"], "recon_fail", self._m_recon_failures)
+        bump(im["repl_pushes"], "repl_push", self._m_repl_pushes)
+        bump(im["repl_bytes"], "repl_bytes", self._m_repl_bytes)
+        bump(im["repl_repairs"], "repl_repair", self._m_repl_repairs)
+        bump(im["repl_recoveries"], "repl_recover", self._m_repl_recoveries)
+        bump(im["ckpt_saves"], "ckpt_saves", self._m_ckpt_saves)
+        bump(im["ckpt_bytes"], "ckpt_bytes", self._m_ckpt_bytes)
+        bump(im["ckpt_restores"], "ckpt_restores", self._m_ckpt_restores)
         if self._pull_manager is not None:
             ps = self._pull_manager.stats()
             im["pull_inflight_bytes"].set(ps["inflight_bytes"])

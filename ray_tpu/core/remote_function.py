@@ -90,6 +90,7 @@ class RemoteFunction:
             max_retries=max_retries,
             retries_left=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            replicate=bool(opts.get("_replicate", False)),
             runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=_placement_from_opts(opts),
         )

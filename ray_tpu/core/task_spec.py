@@ -66,6 +66,20 @@ class TaskSpec:
     # method -> group map (creation task; lets get_actor handles stamp
     # tagged methods' calls with their group)
     method_groups: Optional[Dict[str, str]] = None
+    # Eager availability (reference: secondary object copies, SURVEY §5):
+    # True = every store-sized return of this task is pushed to a second
+    # node when it seals, regardless of the RAY_TPU_REPLICATION_MIN_BYTES
+    # auto-threshold (``_replicate=True`` task/actor-method option).
+    replicate: bool = False
+    # Checkpointable actors (creation task only): snapshot the actor's
+    # __ray_save__() state into a replicated object every N completed
+    # calls; 0 disables.
+    checkpoint_interval: int = 0
+    # Actor restart: restore the new instance from this checkpoint object
+    # (set by the owning raylet when it resubmits the creation task).
+    # Rides dependency_ids() so the ordinary dependency machinery pulls
+    # the checkpoint local before dispatch, wherever the restart lands.
+    restore_oid: Optional[ObjectID] = None
     # Runtime env (env_vars, working_dir) — per-task override
     runtime_env: Optional[dict] = None
     # Placement: pg id hex + bundle index, or node-affinity
@@ -101,4 +115,6 @@ class TaskSpec:
     def dependency_ids(self) -> List[ObjectID]:
         deps = [a[1] for a in self.args if a[0] == "ref"]
         deps += [v[1] for _, v in self.kwargs if v[0] == "ref"]
+        if self.restore_oid is not None:
+            deps.append(self.restore_oid)
         return deps

@@ -243,12 +243,19 @@ class Worker:
             except Exception:  # noqa: BLE001 socket teardown
                 pass
 
-    def put(self, value) -> ObjectRef:
+    def put(self, value, _replicate: bool = False) -> ObjectRef:
+        """``_replicate=True``: eagerly push a secondary copy to another
+        node regardless of the RAY_TPU_REPLICATION_MIN_BYTES threshold
+        (flagged puts route through the store even when small — an inline
+        value lives only in its raylet's memory and cannot be served to a
+        replica holder)."""
         flush_pending_releases()  # free before allocating under pressure
         oid = put_counter.next_object_id()
         ser, inner = serialization.serialize_with_refs(value)
         size = ser.total_bytes()
-        if size <= config.inline_object_max_bytes or self.store is None:
+        inline = (size <= config.inline_object_max_bytes
+                  and not (_replicate and self.store is not None))
+        if inline or self.store is None:
             blob = ser.to_bytes()
             if self.mode == DRIVER:
                 self.raylet.call_async(self.raylet._object_inline, oid, blob,
@@ -259,13 +266,14 @@ class Worker:
         else:
             self.store.put_serialized(oid, ser)
             if self.mode == DRIVER:
-                def _mark(o=oid, n=size, inner=inner):
+                def _mark(o=oid, n=size, inner=inner, rep=_replicate):
                     self.raylet._obj(o).size = n
                     self.raylet._object_in_store(o, contains=inner)
+                    self.raylet._maybe_replicate(o, force=rep)
                 self.raylet.call_async(_mark)
             else:
                 self._request("register_stored", id=oid.hex(), size=size,
-                              contains=inner)
+                              contains=inner, replicate=_replicate)
         return ObjectRef(oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
@@ -697,7 +705,7 @@ class LocalWorker(Worker):
         kwargs = {k: resolve(v) for k, v in spec.kwargs}
         return args, kwargs
 
-    def put(self, value) -> ObjectRef:
+    def put(self, value, _replicate: bool = False) -> ObjectRef:
         oid = put_counter.next_object_id()
         self._objects[oid] = ("v", value)
         return ObjectRef(oid)

@@ -53,6 +53,13 @@ class RemoteWorker(Worker):
         self.actor_executor: Optional[ThreadPoolExecutor] = None
         self.group_executors: Optional[Dict[str, ThreadPoolExecutor]] = None
         self.actor_loop: Optional[asyncio.AbstractEventLoop] = None
+        # Checkpointable actors: snapshot __ray_save__() every
+        # checkpoint_interval completed calls (sync actors only — set by
+        # the creation task).  All three fields touched only on the main
+        # executor thread.
+        self.checkpoint_interval = 0
+        self.checkpoint_calls = 0  # completed calls since last snapshot
+        self.checkpoint_seq = 0
         self._rid = 0  # guard: _rid_lock
         self._rid_lock = make_lock("remote_worker.rid")
         self._pending: Dict[int, dict] = {}
@@ -95,6 +102,11 @@ class RemoteWorker(Worker):
                 os._exit(0)  # raylet gone — die quietly
             t = msg.get("t")
             if t == "task":
+                self.task_queue.put(msg)
+            elif t == "exit_checkpoint":
+                # graceful restart-allowed kill: drain queued calls, take
+                # a final snapshot, then exit — handled on the EXECUTOR
+                # thread (a snapshot mid-call would tear state)
                 self.task_queue.put(msg)
             elif t == "reply":
                 entry = self._pending.pop(msg["rid"], None)
@@ -250,6 +262,52 @@ def _package_results(worker: RemoteWorker, spec: TaskSpec, result):
     return inline, stored, sizes, contains
 
 
+def _save_checkpoint(worker: RemoteWorker):
+    """Serialize the actor's ``__ray_save__()`` state into a fresh object
+    and hand it to the raylet (inline blob, or shm store + size), which
+    records it on the actor and replicates it.  Runs on the executor
+    thread only — never concurrently with a method call."""
+    inst = worker.actor_instance
+    if inst is None:
+        return
+    from ray_tpu.core.ids import put_counter
+
+    oid = put_counter.next_object_id()
+    try:
+        state = inst.__ray_save__()
+        ser = serialization.serialize(state)
+        n = ser.total_bytes()
+        msg = {"t": "checkpoint", "actor_id": worker.current_actor_id,
+               "seq": worker.checkpoint_seq + 1, "id": oid.hex()}
+        if n <= config.inline_object_max_bytes or worker.store is None:
+            msg["inline"] = ser.to_bytes()
+        else:
+            # inside the guard: a full store with spilling disabled
+            # raises ObjectStoreFullError — skip the snapshot, don't
+            # kill the actor
+            worker.store.put_serialized(oid, ser)
+            msg["size"] = n
+    except Exception:  # noqa: BLE001 — a failed snapshot must not kill calls
+        traceback.print_exc()
+        return
+    worker.checkpoint_seq += 1
+    # completed results must reach the raylet BEFORE the snapshot that
+    # includes their effects (socket order preserves the invariant)
+    worker.flush_dones()
+    worker._send(msg)
+
+
+def _maybe_checkpoint(worker: RemoteWorker):
+    """Count a completed actor call toward the checkpoint cadence."""
+    if not worker.checkpoint_interval:
+        return
+    worker.checkpoint_calls += 1
+    if worker.checkpoint_calls < worker.checkpoint_interval:
+        return
+    worker.checkpoint_calls = 0
+    _save_checkpoint(worker)
+
+
 def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
     """Drive a generator task: each yield ships to the raylet immediately
     (reference: streaming generator returns, `_raylet.pyx:224`) so consumers
@@ -382,6 +440,10 @@ def execute_task(worker: RemoteWorker, msg: dict):
     with _run_span(msg["spec"]) as rs:
         ok = _execute_task_inner(worker, msg)
         rs.done(ok)
+        if msg["spec"].kind == ACTOR_TASK:
+            # cadence counts COMPLETED calls (ok or errored — either may
+            # have mutated state); __ray_terminate__ never returns here
+            _maybe_checkpoint(worker)
         return ok
 
 
@@ -403,6 +465,22 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
             worker.actor_instance = cls(*args, **kwargs)
             worker.current_actor_id = spec.actor_id
             _setup_actor_concurrency(worker, spec)
+            worker.checkpoint_interval = spec.checkpoint_interval or 0
+            if worker.checkpoint_interval and worker.actor_loop is not None:
+                # the options-time validation can't see coroutine methods;
+                # fail creation loudly rather than snapshot-while-awaiting
+                raise ValueError(
+                    "checkpoint_interval is not supported on asyncio "
+                    "actors (state may mutate at await points during "
+                    "__ray_save__)")
+            if spec.restore_oid is not None:
+                # warm restart: re-hydrate from the latest checkpoint the
+                # owning raylet attached to this (re)creation
+                blob = msg.get("arg_values", {}).get(spec.restore_oid.hex())
+                state = (serialization.loads(blob) if blob is not None
+                         else worker.read_store_object(spec.restore_oid))
+                worker.actor_instance.__ray_restore__(state)
+                extra["restored"] = True
             # the raylet pipelines calls only to sync actors — report the
             # execution model it can't otherwise see
             extra["async_actor"] = worker.actor_loop is not None
@@ -509,6 +587,14 @@ def main():
     })
     while True:
         msg = worker.task_queue.get()
+        if msg.get("t") == "exit_checkpoint":
+            # restart-allowed kill: final snapshot (queued calls ahead of
+            # this message already ran and are counted in it), then exit —
+            # the raylet restarts the actor from this exact state.
+            if worker.checkpoint_interval:
+                _save_checkpoint(worker)
+            worker.flush_dones()
+            os._exit(0)
         spec: TaskSpec = msg["spec"]
         if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
                 and spec.method_name != "__ray_terminate__"):

@@ -294,6 +294,164 @@ def test_backoff_policy_deterministic():
         assert 0.8 * ideal <= d <= 1.2 * ideal
 
 
+def test_replicated_object_zero_recompute(tmp_path):
+    """Eager availability: with replication on, killing a sealed object's
+    producing node costs a pull from the replica — ZERO lineage
+    recompute.  Proof is cluster-wide: the creating task's side-effect
+    marker shows exactly one run, the reconstruction_attempts metric
+    series never appears in the metrics KV, and the replication series
+    does."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1},
+                env={"RAY_TPU_REPLICATION_MIN_BYTES": str(64 * 1024)})
+    try:
+        victim = c.add_node(num_cpus=2, resources={"data": 1})
+        c.add_node(num_cpus=2, resources={"spare": 1})
+        c.wait_for_nodes(3)
+        c.connect()
+        marker = tmp_path / "runs"
+
+        @ray_tpu.remote(resources={"data": 0.1})
+        def make(path):
+            with open(path, "a") as f:
+                f.write("x")
+            return np.full(1 << 19, 7, np.int32)  # 2MB -> store + replica
+
+        ref = make.remote(str(marker))
+        from ray_tpu.core.gcs import GcsClient
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        cli = GcsClient(c.address)
+        try:
+            _wait_until(
+                lambda: len(cli.get_object_locations(ref.hex())["nodes"])
+                >= 2, timeout=30, msg="secondary copy in the directory")
+            loc = cli.get_object_locations(ref.hex())
+            assert loc["replicas"], "directory did not mark the replica"
+            # The push counter lives on the PRODUCING raylet — assert its
+            # metrics flush BEFORE killing it (soft KV survives the node;
+            # waiting afterwards races the victim's last 1s flush window,
+            # and the survivor's repair can legitimately push 0 copies
+            # when every remaining node already holds the bytes).
+            _wait_until(
+                lambda: any(b"ray_tpu_internal_replication_pushes_total"
+                            in k for k in w.kv_keys(b"",
+                                                    namespace="metrics")),
+                timeout=20, msg="replication metric series in metrics KV")
+
+            c.remove_node(victim)  # SIGKILL the producer / primary holder
+            val = ray_tpu.get(ref, timeout=120)  # served from the replica
+            assert val.shape == (1 << 19,) and int(val[0]) == 7
+            assert marker.read_text().count("x") == 1, "task was re-run"
+            # no raylet attempted a recompute: the reconstruction series
+            # never reaches the metrics KV
+            assert not any(
+                b"ray_tpu_internal_reconstruction_attempts_total" in k
+                for k in w.kv_keys(b"", namespace="metrics"))
+        finally:
+            cli.close()
+    finally:
+        c.shutdown()
+
+
+def test_re_replication_after_holder_death(tmp_path):
+    """After a replica holder dies, a surviving holder restores the
+    target copy count (directory back to >= replication_factor nodes).
+    Also covers the explicit put(..., _replicate=True) flag (worker-side
+    register_stored path) — the object is small enough that the
+    auto-threshold alone would not replicate it."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=2, resources={"data": 1})
+        c.add_node(num_cpus=2, resources={"spare": 1})
+        c.wait_for_nodes(3)
+        c.connect()
+
+        @ray_tpu.remote(resources={"data": 0.1})
+        def make():
+            return [ray_tpu.put(np.full(1 << 17, 3, np.int32),
+                                _replicate=True)]
+
+        (ref,) = ray_tpu.get(make.remote(), timeout=60)
+        from ray_tpu.core.gcs import GcsClient
+
+        cli = GcsClient(c.address)
+        try:
+            _wait_until(
+                lambda: len(cli.get_object_locations(ref.hex())["nodes"])
+                >= 2, timeout=30, msg="flagged put replicated")
+            # kill whichever holder is not the head, then expect repair
+            loc = cli.get_object_locations(ref.hex())
+            holders = set(loc["nodes"])
+            victims = [nd for nd in c.nodes
+                       if nd is not c.head_node and nd.node_id in holders]
+            assert victims, (holders, [nd.node_id for nd in c.nodes])
+            c.remove_node(victims[0])
+            _wait_until(
+                lambda: len(cli.get_object_locations(ref.hex())["nodes"])
+                >= 2, timeout=60,
+                msg="copy count restored after holder death")
+            val = ray_tpu.get(ref, timeout=60)
+            assert int(val[0]) == 3
+        finally:
+            cli.close()
+    finally:
+        c.shutdown()
+
+
+def test_actor_checkpoint_survives_node_death():
+    """Checkpoint-restore round trip under chaos: kill the node an actor
+    executes on mid call-stream; the restart restores the latest
+    __ray_save__ state (no cold start, no call replay)."""
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=1, resources={"slot": 1})
+        c.add_node(num_cpus=1, resources={"slot": 1})
+        c.wait_for_nodes(3)
+        c.connect()
+
+        @ray_tpu.remote(max_restarts=4, resources={"slot": 0.5},
+                        checkpoint_interval=1)
+        class Svc:
+            def __init__(self):
+                self.n = 0
+                self.restored = False
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+            def value(self):
+                return (self.n, self.restored)
+
+            def __ray_save__(self):
+                return self.n
+
+            def __ray_restore__(self, n):
+                self.n = n
+                self.restored = True
+
+        svc = Svc.remote()
+        for i in range(5):
+            assert ray_tpu.get(svc.incr.remote(), timeout=30) == i + 1
+        time.sleep(1.0)  # let the checkpoint relay + owner-side pull land
+        victim = next(nd for nd in c.nodes[1:] if nd.alive())
+        c.remove_node(victim)
+        deadline = time.time() + 90
+        val = None
+        while time.time() < deadline:
+            try:
+                val = ray_tpu.get(svc.value.remote(), timeout=10)
+                break
+            except (ray_tpu.ActorDiedError, ray_tpu.GetTimeoutError):
+                time.sleep(0.5)
+        # n == 5 (restored state, incr calls NOT replayed); restored flag
+        # proves the warm path ran, not a cold __init__
+        assert val == (5, True), val
+    finally:
+        c.shutdown()
+
+
 @pytest.mark.slow
 def test_oom_killer_retriable_fifo(tmp_path):
     """With the memory monitor reading a test-seam usage file, crossing
