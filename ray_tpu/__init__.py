@@ -235,6 +235,9 @@ def nodes() -> List[dict]:
     return [{
         "NodeID": n["node_id"],
         "Alive": n.get("alive", True),
+        "Suspect": bool(n.get("suspect")),
+        "Draining": bool(n.get("draining")),
+        "Incarnation": n.get("incarnation", 0),
         "Resources": n.get("resources_total", {}),
         "Address": n.get("address"),
         "Hostname": n.get("hostname", ""),

@@ -178,6 +178,11 @@ class StandardAutoscaler:
         self._gcs = GcsClient(gcs_address)
         self.num_launches = 0
         self.num_terminations = 0
+        # Graceful downscale in flight: node_id -> {key, type, deadline}.
+        # The instance is terminated only after the raylet reports
+        # drain_complete (zero reconstructions) or the deadline passes.
+        self._draining: Dict[str, dict] = {}
+        self.drain_grace_s = 30.0
 
     def update(self) -> None:
         load = self._gcs.load_metrics()
@@ -186,6 +191,12 @@ class StandardAutoscaler:
         counts: Dict[str, int] = {}
         for nid, t in provider_nodes.items():
             counts[t] = counts.get(t, 0) + 1
+        # Nodes already draining toward termination are as good as gone:
+        # exclude them so the min_workers floor check can't spend the same
+        # slot twice across update passes.
+        for entry in self._draining.values():
+            if entry["key"] in provider_nodes:
+                counts[entry["type"]] = counts.get(entry["type"], 1) - 1
 
         # 1. min_workers floor per type.
         to_launch: Dict[str, int] = {}
@@ -236,21 +247,47 @@ class StandardAutoscaler:
                 floor = self.node_types.get(t, {}).get("min_workers", 0)
                 if counts.get(t, 0) <= floor:
                     continue
-                if m["idle_s"] >= self.idle_timeout_s:
-                    # Drain first (placement skips the node but heartbeats
-                    # keep succeeding, so the raylet does NOT re-register),
-                    # then kill, then clean up membership.
+                if m["idle_s"] >= self.idle_timeout_s \
+                        and nid not in self._draining:
+                    # GRACEFUL downscale (reference: DrainNode before
+                    # instance termination): the drain RPC stops new
+                    # placement immediately and asks the raylet to migrate
+                    # sole-copy objects + checkpoint-and-relocate actors;
+                    # the instance is terminated on drain_complete (below)
+                    # — an idle-scale-down never pays the crash-recovery
+                    # path.
                     try:
-                        self._gcs.drain_node(nid)
+                        ok = self._gcs.drain_node(
+                            nid, timeout_s=self.drain_grace_s)
                     except Exception:  # noqa: BLE001
-                        pass
-                    self.provider.terminate_node(key)
-                    try:
-                        self._gcs.unregister_node(nid)
-                    except Exception:  # noqa: BLE001
-                        pass
-                    counts[t] -= 1
-                    self.num_terminations += 1
+                        ok = False
+                    self._draining[nid] = {
+                        "key": key, "type": t,
+                        "deadline": time.monotonic()
+                        + (self.drain_grace_s + 5.0 if ok else 0.0),
+                    }
+                    # Spend the slot now so a second idle node of the same
+                    # type can't also pass the floor check this pass.
+                    counts[t] = counts.get(t, 1) - 1
+        self._reap_drained()
+
+    def _reap_drained(self) -> None:
+        """Terminate instances whose drain completed (or timed out)."""
+        for nid, entry in list(self._draining.items()):
+            try:
+                status = self._gcs.drain_status(nid)
+            except Exception:  # noqa: BLE001
+                status = {"state": "unknown"}
+            if status.get("state") != "drained" \
+                    and time.monotonic() < entry["deadline"]:
+                continue
+            del self._draining[nid]
+            self.provider.terminate_node(entry["key"])
+            try:
+                self._gcs.unregister_node(nid)
+            except Exception:  # noqa: BLE001
+                pass
+            self.num_terminations += 1
 
     def close(self) -> None:
         try:

@@ -163,6 +163,20 @@ class Cluster:
                 time.sleep(0.3)
         raise RuntimeError(f"could not restart GCS: {last_err}")
 
+    def pause_node(self, node: NodeHandle):
+        """SIGSTOP the raylet process — simulates a network partition /
+        long stall: the node stops heartbeating and answering liveness
+        probes while its sockets stay open, so the GCS suspicion machine
+        declares it dead; ``resume_node`` then 'heals the partition' and
+        the resurrected raylet learns it was fenced."""
+        if node.alive():
+            node.proc.send_signal(signal.SIGSTOP)
+
+    def resume_node(self, node: NodeHandle):
+        """SIGCONT a paused raylet (heal the simulated partition)."""
+        if node.alive():
+            node.proc.send_signal(signal.SIGCONT)
+
     def remove_node(self, node: NodeHandle, allow_graceful: bool = False):
         """SIGKILL by default — simulates node failure (reference:
         ``Cluster.remove_node`` / NodeKillerActor chaos tooling)."""

@@ -9,7 +9,11 @@ control RPCs.
 
 Wire format (little-endian, NO pickle anywhere on this channel):
 
-  connect preamble   8 bytes  b"RTDP\\x01\\0\\0\\0"
+  connect preamble   8 bytes  b"RTDP\\x02\\0\\0\\0"
+  hello (pull side -> holder)      _HELLO: incarnation u64 | node_id 32s
+      identity + fencing: a channel presenting an incarnation the cluster
+      declared dead is refused (split-brain guard — a resurrected
+      partitioned node must re-register before it may move bytes)
   request  (pull side -> holder)   _REQ:  op u8 | rid u64 | offset u64 |
                                           length u64 | object_id 20s
       op 1 = META   (offset/length ignored; reply carries the total size)
@@ -44,10 +48,13 @@ from ray_tpu.core.protocol import recv_into_exact
 from ray_tpu.util import chaos as _chaos
 from ray_tpu.util.locks import make_lock
 
-MAGIC = b"RTDP\x01\x00\x00\x00"
+MAGIC = b"RTDP\x02\x00\x00\x00"
 
 _REQ = struct.Struct("<BQQQ20s")
 _RESP = struct.Struct("<BQQQ")
+# connection hello: the pull side's identity + registration incarnation
+# (node_id as 32 hex bytes; fencing input for the serving side)
+_HELLO = struct.Struct("<Q32s")
 
 OP_META = 1
 OP_READ = 2
@@ -87,8 +94,13 @@ class DataServer:
     slow or stalled peer can never head-of-line-block the control plane.
     """
 
-    def __init__(self, node_ip: str, store_fn: Callable[[], object]):
+    def __init__(self, node_ip: str, store_fn: Callable[[], object],
+                 fence_fn: Optional[Callable[[str, int], bool]] = None):
+        """``fence_fn(node_id, incarnation) -> bool``: incarnation-fencing
+        check for the connect hello — False refuses the connection (the
+        peer presented an incarnation that was declared dead)."""
         self._store_fn = store_fn
+        self._fence_fn = fence_fn
         self._listener = socket.create_server((node_ip, 0), backlog=32)
         self.port = self._listener.getsockname()[1]
         self._conns: Dict[int, socket.socket] = {}  # guard: _lock
@@ -131,6 +143,14 @@ class DataServer:
             magic = _recv_exact(sock, len(MAGIC))
             if magic is None or bytes(magic) != MAGIC:
                 return
+            hello = _recv_exact(sock, _HELLO.size)
+            if hello is None:
+                return
+            incarnation, peer_id_raw = _HELLO.unpack(bytes(hello))
+            peer_id = peer_id_raw.rstrip(b"\x00").decode("ascii", "replace")
+            if (self._fence_fn is not None
+                    and not self._fence_fn(peer_id, incarnation)):
+                return  # fenced incarnation: refuse to move bytes for it
             while not self._closed:
                 hdr = _recv_exact(sock, _REQ.size)
                 if hdr is None:
@@ -138,7 +158,8 @@ class DataServer:
                 op, rid, offset, length, oid_bytes = _REQ.unpack(bytes(hdr))
                 oid = ObjectID(oid_bytes)
                 if not blackholed:
-                    fault = _chaos.net_fault("data")
+                    fault = _chaos.net_fault("data", peer=peer_id,
+                                             direction="in")
                     if fault == "blackhole":
                         blackholed = True
                     if fault is not None:
@@ -292,7 +313,10 @@ class DataChannel:
     def __init__(self, node_id: str, address: Tuple[str, int],
                  on_event: Callable[["DataChannel", Optional[int], str,
                                      object], None],
-                 connect_timeout: float = 3.0):
+                 connect_timeout: float = 3.0,
+                 identity: Optional[Tuple[str, int]] = None):
+        """``identity``: this (pulling) node's ``(node_id, incarnation)``,
+        sent in the connect hello for the server's fencing check."""
         self.node_id = node_id
         self._on_event = on_event
         self._sock = socket.create_connection(address,
@@ -302,7 +326,10 @@ class DataChannel:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
-        self._sock.sendall(MAGIC)
+        my_id, my_inc = identity or ("", 0)
+        self._sock.sendall(MAGIC + _HELLO.pack(
+            int(my_inc), my_id.encode("ascii", "replace")[:32].ljust(
+                32, b"\x00")))
         self._send_lock = make_lock("data_channel.send")
         self._sinks: Dict[int, memoryview] = {}  # guard: _sinks_lock
         self._sinks_lock = make_lock("data_channel.sinks")
@@ -343,7 +370,7 @@ class DataChannel:
             return False
         if self._chaos_blackholed:
             return True  # partitioned: the request silently vanishes
-        fault = _chaos.net_fault("data")
+        fault = _chaos.net_fault("data", peer=self.node_id)
         if fault is not None:
             if fault == "blackhole":
                 self._chaos_blackholed = True

@@ -28,6 +28,7 @@ and the object manager (`src/ray/object_manager/object_manager.h:117`).
 from __future__ import annotations
 
 import os
+import random
 import socket
 import threading
 import time
@@ -47,7 +48,31 @@ config.define("gcs_restart_reconcile_s", float, 5.0,
               "are reconciled (actors -> dead, bundles -> re-placed).")
 config.define("gcs_node_timeout_s", float, 3.0,
               "Heartbeat silence after which a node is declared dead "
-              "(reference: health check manager timeouts).")
+              "with no probe verdict — the HARD fallback behind the "
+              "suspicion machine (reference: health check manager "
+              "timeouts).")
+config.define("gcs_node_suspect_s", float, 0.5,
+              "Heartbeat silence after which a node is marked SUSPECT "
+              "and actively probed (direct TCP ping plus one indirect "
+              "probe via a peer raylet).  Probe failure confirms DEAD "
+              "well before gcs_node_timeout_s; probe success resets the "
+              "suspicion.  SUSPECT is propagated on the node-change "
+              "pubsub so schedulers/pulls route around the node without "
+              "triggering recovery (reference: the health-check "
+              "manager's ping layer over heartbeats).")
+config.define("gcs_probe_timeout_s", float, 0.4,
+              "Connect/read timeout for one liveness probe attempt "
+              "(direct or relayed through a peer raylet).")
+config.define("gcs_probe_enabled", bool, True,
+              "Active probing of SUSPECT nodes.  Off: detection falls "
+              "back to the plain gcs_node_timeout_s heartbeat silence.")
+config.define("drain_timeout_s", float, 30.0,
+              "Default graceful-drain deadline: how long a draining "
+              "raylet gets to migrate sole-copy objects out, "
+              "checkpoint-and-relocate checkpointable actors, and wait "
+              "for running tasks before it reports drain_complete "
+              "regardless (reference: the autoscaler's DrainNode "
+              "deadline).")
 
 
 class GcsCore:
@@ -99,6 +124,32 @@ class GcsCore:
         self._stop = threading.Event()
         self._restored = False  # snapshot loaded => this is a restart
         self._kv_soft_ts: Dict[Tuple[str, bytes], float] = {}  # guard: _lock
+        # ---- failure detection / fencing state ----
+        # node_id -> highest incarnation ever assigned.  PERSISTED (tiny,
+        # monotonic counters): a GCS restart must not hand a resurrected
+        # partitioned node its old incarnation back — fencing depends on
+        # stale incarnations staying stale.  Node MEMBERSHIP stays soft.
+        self._incarnations: Dict[str, int] = {}  # guard: _lock
+        # node_id -> highest incarnation ever DECLARED DEAD.  Also
+        # persisted: node membership is soft, so after a GCS restart a
+        # healed zombie's heartbeat would otherwise look like a plain
+        # "unknown node, please re-register" — it must instead learn it
+        # was fenced, kill its stale workers, and only then come back.
+        self._fenced_incs: Dict[str, int] = {}  # guard: _lock
+        self._probing: set = set()  # nodes with an in-flight probe  # guard: _lock
+        # token -> {"event": Event, "ok": bool} for indirect (peer-relayed)
+        # probes; replies land via the probe_report op.
+        self._probe_waiters: Dict[str, dict] = {}  # guard: _lock
+        # drain lifecycle: node_id -> {state: draining|drained, started, stats}
+        self._drains: Dict[str, dict] = {}  # guard: _lock
+        # detection/fencing counters (surfaced by health_stats + metrics)
+        self._m_suspects = 0        # guard: _lock — SUSPECT transitions
+        self._m_false_suspects = 0  # guard: _lock — suspects that recovered
+        self._m_fenced = 0          # guard: _lock — rejected stale frames
+        self._m_deaths = 0          # guard: _lock — detected (non-drain) deaths
+        self._m_probe_deaths = 0    # guard: _lock — deaths confirmed by probe
+        self._m_ttd: deque = deque(maxlen=256)  # guard: _lock — detect latencies
+        self._gm: Optional[dict] = None  # internal metric instruments
         if persist_path:
             self._load_snapshot()
             self._start_flusher()
@@ -123,6 +174,8 @@ class GcsCore:
             self._actors = snap.get("actors", {})
             self._named = snap.get("named", {})
             self._cluster_pgs = snap.get("cluster_pgs", {})
+            self._incarnations = snap.get("incarnations", {})
+            self._fenced_incs = snap.get("fenced_incarnations", {})
             # Actors whose host nodes are gone (nodes are soft state) are
             # surfaced as restarting; their home raylet reconciles on
             # reconnect.  start_restart_reconciler() handles the raylets
@@ -162,6 +215,8 @@ class GcsCore:
                             "assignments": dict(v["assignments"]),
                             "pending": set(v["pending"])}
                         for k, v in self._cluster_pgs.items()},
+                    "incarnations": dict(self._incarnations),
+                    "fenced_incarnations": dict(self._fenced_incs),
                 }
                 self._dirty = False
             try:
@@ -224,14 +279,33 @@ class GcsCore:
                       store_path: Optional[str] = None,
                       hostname: str = "",
                       labels: Optional[Dict[str, str]] = None,
-                      data_port: Optional[int] = None) -> List[dict]:
+                      data_port: Optional[int] = None,
+                      incarnation: Optional[int] = None) -> List[dict]:
         """``labels`` carry scheduler-visible topology metadata (SURVEY §7
         items 3-4): ``accelerator_type`` (e.g. "v5e-8"), ``tpu_slice``
         (the pod-slice id — nodes sharing it are ICI-adjacent),
         ``tpu_topology`` ("2x4"), ``tpu_worker_id`` (coords within the
         slice).  STRICT_PACK placement uses ``tpu_slice`` to pack bundles
-        across hosts of ONE slice when a single node can't hold them."""
+        across hosts of ONE slice when a single node can't hold them.
+
+        ``incarnation``: the generation the raylet LAST HELD (0 for a
+        fresh node).  The assigned value is ALWAYS strictly greater than
+        both it and any value this GCS previously assigned for the
+        node_id, so frames stamped with an older incarnation are
+        rejectable after a death declaration — the fencing that makes a
+        healed partition unable to double-execute (reference: raylet
+        restarts bump the node's instance id).  The caller proposal
+        matters when the GCS itself lost its counters (restart without
+        persistence): without it the node would be re-assigned a number
+        its peers have already fenced and be rejected by them forever.
+        The caller reads its assigned incarnation back out of the
+        returned snapshot."""
         with self._lock:
+            inc = max(self._incarnations.get(node_id, 0),
+                      int(incarnation or 0)) + 1
+            self._incarnations[node_id] = inc
+            if self._persist_path:
+                self._mark_dirty()
             self._nodes[node_id] = {
                 "node_id": node_id,
                 "address": address,
@@ -244,41 +318,141 @@ class GcsCore:
                 "hostname": hostname,
                 "labels": dict(labels or {}),
                 "alive": True,
+                "suspect": False,
+                "incarnation": inc,
                 "last_heartbeat": time.monotonic(),
             }
             snapshot = [dict(n) for n in self._nodes.values()]
-        self._publish("node_added", {"node_id": node_id, "address": address})
+        # Persist the incarnation bump SYNCHRONOUSLY (registrations are
+        # rare): if the GCS dies before the async flusher runs, a restart
+        # would re-assign the fenced number and peers would reject the
+        # legitimately re-registered node forever.  A failed write re-marks
+        # dirty; registration still proceeds (soft membership).
+        if self._persist_path:
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        self._publish("node_added", {"node_id": node_id, "address": address,
+                                     "incarnation": inc,
+                                     "data_port": data_port})
         return snapshot
 
     def unregister_node(self, node_id: str):
-        self._mark_dead(node_id, "node drained")
+        # announced departure, not a detected failure: keep it out of the
+        # time-to-detect distribution
+        self._mark_dead(node_id, "node drained", detected=False)
 
-    def drain_node(self, node_id: str):
-        """Mark a node as draining: no new task/PG placement lands on it,
-        but it stays alive (and its heartbeats keep succeeding, so it does
-        not re-register) until actually terminated (reference: the
-        autoscaler's DrainNode RPC before instance termination)."""
-        with self._lock:
-            info = self._nodes.get(node_id)
-            if info is not None:
-                info["draining"] = True
-
-    def heartbeat(self, node_id: str, resources_available: Dict[str, float],
-                  queue_len: int = 0, pending_shapes=None) -> bool:
-        """``pending_shapes`` is the node's unfulfilled resource demand:
-        ``[(shape_dict, count), ...]`` for queued tasks that cannot run with
-        current availability — the load signal the autoscaler bin-packs
-        (reference: raylet resource reports aggregated by
-        ``monitor.py:249`` ``update_load_metrics``)."""
+    def drain_node(self, node_id: str,
+                   timeout_s: Optional[float] = None) -> bool:
+        """Begin a GRACEFUL drain: placement skips the node immediately
+        (draining flag) and the node's raylet is asked — via a targeted
+        ``node_drain`` push — to migrate sole-copy store objects out,
+        checkpoint-and-relocate checkpointable actors, and wait for
+        running tasks up to ``timeout_s``, then report ``drain_complete``
+        (reference: the autoscaler's DrainNode RPC before instance
+        termination).  A drained node dies with ZERO reconstructions.
+        Returns False for an unknown/dead node."""
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
                 return False
+            info["draining"] = True
+            self._drains[node_id] = {"state": "draining",
+                                     "started": time.monotonic()}
+        # BROADCAST, not targeted: the draining raylet starts its drain,
+        # and every OTHER raylet marks the node draining so replication
+        # pushes and locality forwarding stop landing fresh bytes/tasks on
+        # a node that is about to retire (their candidate filters check
+        # the flag; without the broadcast they would never learn it).
+        self._publish("node_drain",
+                      {"node_id": node_id,
+                       "timeout_s": timeout_s or config.drain_timeout_s})
+        return True
+
+    def drain_complete(self, node_id: str, stats: Optional[dict] = None):
+        """The draining raylet quiesced (or hit its deadline): record the
+        outcome and retire the node through the normal death path — by
+        now every sole-copy object has a surviving holder, so the death
+        event triggers zero reconstructions."""
+        with self._lock:
+            entry = self._drains.setdefault(
+                node_id, {"state": "draining", "started": time.monotonic()})
+            entry["state"] = "drained"
+            entry["elapsed_s"] = time.monotonic() - entry["started"]
+            entry["stats"] = dict(stats or {})
+        self._mark_dead(node_id, "node drained", detected=False)
+
+    def drain_status(self, node_id: str) -> dict:
+        with self._lock:
+            entry = self._drains.get(node_id)
+            if entry is None:
+                return {"state": "unknown"}
+            out = dict(entry)
+            out.pop("started", None)
+            return out
+
+    def _fence_ok(self, node_id: str, incarnation: Optional[int]) -> bool:  # requires: _lock
+        """Accept/reject a node-attributed mutating frame.  ``None`` means
+        an unstamped caller (tests, pre-fencing components): accepted as
+        before.  A stamped frame is rejected when the node is not alive or
+        the stamp is older than the node's current incarnation — the
+        split-brain guard: a node declared dead that keeps sending
+        (partition healed, process resumed) cannot resurrect directory
+        entries or re-assert actors until it re-registers fresh."""
+        if incarnation is None:
+            return True
+        info = self._nodes.get(node_id)
+        if (info is not None and info["alive"]
+                and int(incarnation) >= info["incarnation"]):
+            return True
+        self._m_fenced += 1
+        return False
+
+    def heartbeat(self, node_id: str, resources_available: Dict[str, float],
+                  queue_len: int = 0, pending_shapes=None,
+                  incarnation: Optional[int] = None):
+        """``pending_shapes`` is the node's unfulfilled resource demand:
+        ``[(shape_dict, count), ...]`` for queued tasks that cannot run with
+        current availability — the load signal the autoscaler bin-packs
+        (reference: raylet resource reports aggregated by
+        ``monitor.py:249`` ``update_load_metrics``).
+
+        Returns True (accepted), False (unknown node — re-register), or
+        the string ``"fenced"`` (this node_id+incarnation was declared
+        dead: the raylet must kill its workers and re-register under a
+        fresh incarnation before any of its frames are accepted again)."""
+        recovered = None
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if incarnation is not None and \
+                    int(incarnation) <= self._fenced_incs.get(node_id, -1):
+                # declared dead under this (or an older) incarnation —
+                # membership may be gone (GCS restart; nodes are soft
+                # state) but the persisted fence record is not: the
+                # zombie must kill its workers before re-registering
+                self._m_fenced += 1
+                return "fenced"
+            if info is None:
+                return False
+            if incarnation is not None and (
+                    not info["alive"]
+                    or int(incarnation) < info["incarnation"]):
+                self._m_fenced += 1
+                return "fenced"
+            if not info["alive"]:
+                return False  # unstamped legacy caller: plain re-register
             info["resources_available"] = dict(resources_available)
             info["queue_len"] = queue_len
             info["pending_shapes"] = list(pending_shapes or ())
             now = time.monotonic()
             info["last_heartbeat"] = now
+            if info.get("suspect"):
+                # the node was only slow (GC pause, load): clear the
+                # suspicion without any recovery action
+                info["suspect"] = False
+                self._m_false_suspects += 1
+                recovered = info["incarnation"]
             busy = (queue_len > 0 or pending_shapes
                     or any(resources_available.get(k, 0.0) + 1e-9 < v
                            for k, v in info["resources_total"].items()))
@@ -286,7 +460,11 @@ class GcsCore:
                 info.pop("idle_since", None)
             elif "idle_since" not in info:
                 info["idle_since"] = now
-            return True
+        if recovered is not None:
+            self._publish("node_suspect",
+                          {"node_id": node_id, "suspect": False,
+                           "incarnation": recovered})
+        return True
 
     def load_metrics(self) -> List[dict]:
         """Autoscaler view: per-node capacity, availability, queue depth,
@@ -298,6 +476,8 @@ class GcsCore:
                 out.append({
                     "node_id": info["node_id"],
                     "alive": info["alive"],
+                    "suspect": bool(info.get("suspect")),
+                    "draining": bool(info.get("draining")),
                     "hostname": info.get("hostname", ""),
                     "resources_total": dict(info["resources_total"]),
                     "resources_available": dict(
@@ -319,12 +499,27 @@ class GcsCore:
             info = self._nodes.get(node_id)
             return dict(info) if info else None
 
-    def _mark_dead(self, node_id: str, reason: str):
+    def _mark_dead(self, node_id: str, reason: str, detected: bool = True):
+        """``detected``: this death was INFERRED (missed heartbeats /
+        failed probe) rather than announced (drain, graceful shutdown) —
+        only inferred deaths feed the time-to-detect distribution."""
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info["alive"]:
                 return
             info["alive"] = False
+            info["suspect"] = False
+            info["death_reason"] = reason
+            incarnation = info["incarnation"]
+            self._fenced_incs[node_id] = max(
+                self._fenced_incs.get(node_id, 0), incarnation)
+            self._mark_dirty()  # the fence must survive a GCS restart
+            if detected:
+                self._m_deaths += 1
+                self._m_ttd.append(
+                    time.monotonic() - info["last_heartbeat"])
+                if self._gm is not None:
+                    self._gm["ttd"].observe(self._m_ttd[-1])
             # prune the directory: bytes on a dead node are gone.  Entries
             # with no holder left are DELETED, not kept with stale
             # metadata — their max()-accumulated size must not outlive the
@@ -336,7 +531,8 @@ class GcsCore:
                 entry.get("replicas", set()).discard(node_id)
                 if not entry["nodes"]:
                     del self._objects[oid]
-        self._publish("node_dead", {"node_id": node_id, "reason": reason})
+        self._publish("node_dead", {"node_id": node_id, "reason": reason,
+                                    "incarnation": incarnation})
         self._repair_pgs_for_dead_node(node_id)
 
     def _repair_pgs_for_dead_node(self, node_id: str):
@@ -434,21 +630,46 @@ class GcsCore:
     def start_health_monitor(self):
         if self._monitor is not None:
             return
+        self._init_health_metrics()
 
         def loop():
             period = max(0.05, config.gcs_heartbeat_interval_s / 2)
             soft_sweep_at = time.monotonic() + self._SOFT_KV_TTL_S
+            metrics_at = time.monotonic() + 1.0
             while not self._stop.wait(period):
                 timeout = config.gcs_node_timeout_s
+                suspect_after = config.gcs_node_suspect_s
+                probing = config.gcs_probe_enabled
                 now = time.monotonic()
+                stale, suspects = [], []
                 with self._lock:
-                    stale = [
-                        nid for nid, info in self._nodes.items()
-                        if info["alive"] and info["address"] is not None
-                        and now - info["last_heartbeat"] > timeout
-                    ]
+                    for nid, info in self._nodes.items():
+                        if not info["alive"] or info["address"] is None:
+                            continue
+                        silent = now - info["last_heartbeat"]
+                        if silent > timeout:
+                            # hard fallback: probes never concluded (or
+                            # probing is off) — plain heartbeat silence
+                            stale.append(nid)
+                        elif (probing and silent > suspect_after
+                                and not info.get("suspect")):
+                            info["suspect"] = True
+                            self._m_suspects += 1
+                            if self._gm is not None:
+                                self._gm["suspects"].inc()
+                            suspects.append((nid, info["incarnation"]))
                 for nid in stale:
                     self._mark_dead(nid, "missed heartbeats")
+                for nid, inc in suspects:
+                    # a SUSPECT node is routed around but NOT recovered:
+                    # reconstruction/replication repair only fires on DEAD
+                    self._publish("node_suspect",
+                                  {"node_id": nid, "suspect": True,
+                                   "incarnation": inc})
+                    self._start_probe(nid)
+                if now >= metrics_at:
+                    metrics_at = now + 1.0
+                    self._flush_health_metrics()
                 if now >= soft_sweep_at:
                     # TTL sweep of soft KV (dead metric producers)
                     soft_sweep_at = now + self._SOFT_KV_TTL_S
@@ -463,6 +684,173 @@ class GcsCore:
         self._monitor = threading.Thread(target=loop, name="gcs-health",
                                          daemon=True)
         self._monitor.start()
+
+    # ------------------------------------------------- liveness probing
+
+    def _start_probe(self, node_id: str):
+        with self._lock:
+            if node_id in self._probing:
+                return
+            self._probing.add(node_id)
+        threading.Thread(target=self._probe_node, args=(node_id,),
+                         name=f"gcs-probe-{node_id[:8]}",
+                         daemon=True).start()
+
+    def _probe_node(self, node_id: str):
+        """Prober thread for ONE suspect node: a direct TCP ping, then —
+        so a GCS<->node link blip can't kill a healthy node — one
+        indirect ping relayed through a peer raylet.  Either success
+        clears the suspicion; both failing confirms DEAD immediately
+        (sub-second, vs waiting out gcs_node_timeout_s)."""
+        try:
+            with self._lock:
+                info = self._nodes.get(node_id)
+                if (info is None or not info["alive"]
+                        or not info.get("suspect")):
+                    return
+                addr = info["address"]
+                inc = info["incarnation"]
+                hb = info["last_heartbeat"]
+            ok = self._direct_probe(addr, node_id, inc)
+            if not ok:
+                ok = self._indirect_probe(node_id, addr, inc)
+            publish_recovered = False
+            with self._lock:
+                info = self._nodes.get(node_id)
+                if info is None or not info["alive"]:
+                    return
+                if info["last_heartbeat"] > hb or not info.get("suspect"):
+                    return  # a heartbeat raced the probe: already settled
+                if ok:
+                    info["suspect"] = False
+                    # defer the next suspicion cycle: the node answered a
+                    # ping NOW, so treat the probe as a liveness proof even
+                    # though heartbeats are still in flight
+                    info["last_heartbeat"] = time.monotonic()
+                    self._m_false_suspects += 1
+                    publish_recovered = True
+                else:
+                    self._m_probe_deaths += 1
+            if publish_recovered:
+                self._publish("node_suspect",
+                              {"node_id": node_id, "suspect": False,
+                               "incarnation": inc})
+            elif not ok:
+                self._mark_dead(node_id,
+                                "liveness probe failed after missed "
+                                "heartbeats")
+        finally:
+            with self._lock:
+                self._probing.discard(node_id)
+
+    def _direct_probe(self, address, node_id: str, incarnation: int) -> bool:
+        return protocol.liveness_ping(address, node_id, incarnation,
+                                      config.gcs_probe_timeout_s)
+
+    def _indirect_probe(self, target: str, address, incarnation: int) -> bool:
+        """Ask one healthy peer raylet to ping the target and report back
+        (probe_report op).  Covers the asymmetric-partition case where the
+        GCS can't reach a node its peers still can."""
+        with self._lock:
+            helpers = [
+                nid for nid, info in self._nodes.items()
+                if info["alive"] and not info.get("suspect")
+                and nid != target and info["address"] is not None
+            ]
+            if not helpers:
+                return False
+            helper = random.choice(helpers)
+            token = f"{target}:{incarnation}:{self._m_suspects}"
+            waiter = {"event": threading.Event(), "ok": False}
+            self._probe_waiters[token] = waiter
+        self._publish("node_probe",
+                      {"target": target, "address": tuple(address),
+                       "incarnation": incarnation, "token": token},
+                      target_node=helper)
+        waiter["event"].wait(max(0.05, config.gcs_probe_timeout_s) * 2)
+        with self._lock:
+            self._probe_waiters.pop(token, None)
+        return waiter["ok"]
+
+    def probe_report(self, token: str, ok: bool):
+        """Indirect-probe verdict from the helper raylet."""
+        with self._lock:
+            waiter = self._probe_waiters.get(token)
+        if waiter is not None:
+            waiter["ok"] = bool(ok)
+            waiter["event"].set()
+
+    def health_stats(self) -> dict:
+        """Failure-detection observability: suspicion / fencing counters
+        and the recent time-to-detect distribution (also exported as
+        ray_tpu_internal_* series into the metrics KV)."""
+        with self._lock:
+            ttd = sorted(self._m_ttd)
+            return {
+                "suspects_total": self._m_suspects,
+                "false_suspects_total": self._m_false_suspects,
+                "fenced_frames_total": self._m_fenced,
+                "deaths_detected_total": self._m_deaths,
+                "probe_confirmed_deaths_total": self._m_probe_deaths,
+                "time_to_detect_s": ttd,
+                "time_to_detect_p50_s":
+                    ttd[len(ttd) // 2] if ttd else None,
+                "drains": {nid: {k: v for k, v in d.items()
+                                 if k != "started"}
+                           for nid, d in self._drains.items()},
+            }
+
+    def _init_health_metrics(self):
+        """GCS-side ray_tpu_internal_* series, flushed straight into this
+        core's OWN metrics KV namespace (the GCS has no worker/raylet
+        flusher of its own; the dashboard's /metrics merges producers)."""
+        try:
+            from ray_tpu.util import metrics as _metrics
+
+            tags = {"node": "gcs"}
+            self._gm = {
+                "suspects": _metrics.internal_metric(
+                    _metrics.Counter, "ray_tpu_internal_node_suspects_total",
+                    "Nodes marked SUSPECT after missed heartbeats",
+                    tag_keys=("node",)).set_default_tags(tags),
+                "fenced": _metrics.internal_metric(
+                    _metrics.Counter, "ray_tpu_internal_fenced_frames_total",
+                    "Stale node-attributed frames rejected by incarnation "
+                    "fencing", tag_keys=("node",)).set_default_tags(tags),
+                "ttd": _metrics.internal_metric(
+                    _metrics.Histogram, "ray_tpu_internal_time_to_detect_s",
+                    "Last-contact to death-declaration latency for "
+                    "detected node failures",
+                    boundaries=(0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0),
+                    tag_keys=("node",)).set_default_tags(tags),
+            }
+            self._gm_fenced_last = 0
+        except Exception:  # noqa: BLE001 — stats-only fallback
+            self._gm = None
+
+    def _flush_health_metrics(self):
+        if self._gm is None:
+            return
+        import json as _json
+
+        with self._lock:
+            fenced = self._m_fenced
+        delta = fenced - self._gm_fenced_last
+        if delta > 0:
+            self._gm["fenced"].inc(delta)
+        self._gm_fenced_last = fenced
+        items = []
+        for m in self._gm.values():
+            try:
+                payload = m._export()
+            except Exception:  # noqa: BLE001
+                continue
+            if payload is None:
+                continue
+            items.append((f"gcs-{os.getpid()}/{m.name}".encode(),
+                          _json.dumps(payload).encode()))
+        if items:
+            self.kv_multi_put("metrics", items)
 
     def stop(self):
         self._stop.set()
@@ -498,7 +886,7 @@ class GcsCore:
                             + (entry["size"] or 0)
             for nid, info in self._nodes.items():
                 if not info["alive"] or nid in exclude \
-                        or info.get("draining"):
+                        or info.get("draining") or info.get("suspect"):
                     continue
                 avail = info["resources_available"]
                 if all(avail.get(k, 0.0) + 1e-9 >= v
@@ -553,16 +941,18 @@ class GcsCore:
         """Greedy placement against the latest heartbeat availability;
         falls back to capacity totals so a currently-busy cluster still
         places (fragments then pend locally until resources free)."""
+        def placeable(info) -> bool:
+            return (info["alive"] and not info.get("draining")
+                    and not info.get("suspect"))
+
         with self._lock:
             nodes = {nid: dict(info["resources_available"])
-                     for nid, info in self._nodes.items()
-                     if info["alive"] and not info.get("draining")}
+                     for nid, info in self._nodes.items() if placeable(info)}
             totals = {nid: dict(info["resources_total"])
-                      for nid, info in self._nodes.items()
-                      if info["alive"] and not info.get("draining")}
+                      for nid, info in self._nodes.items() if placeable(info)}
             slices = {nid: info.get("labels", {}).get("tpu_slice")
                       for nid, info in self._nodes.items()
-                      if info["alive"] and not info.get("draining")}
+                      if placeable(info)}
         if not nodes:
             return None
 
@@ -754,9 +1144,14 @@ class GcsCore:
 
     def register_actor(self, actor_id: bytes, owner_node: str,
                        name: Optional[str] = None, namespace: str = "",
-                       spec_blob: Optional[bytes] = None) -> bool:
-        """False when the (namespace, name) is already taken."""
+                       spec_blob: Optional[bytes] = None,
+                       incarnation: Optional[int] = None) -> bool:
+        """False when the (namespace, name) is already taken — or when the
+        registering node is fenced (a resurrected partitioned node must
+        not re-assert actors the cluster already restarted elsewhere)."""
         with self._lock:
+            if not self._fence_ok(owner_node, incarnation):
+                return False
             if name:
                 existing = self._named.get((namespace, name))
                 if existing is not None and existing != actor_id:
@@ -826,13 +1221,19 @@ class GcsCore:
     # ----------------------------------------------------------- objects
 
     def add_object_location(self, oid: str, node_id: str, size: int = 0,
-                            inline: bool = False, replica: bool = False):
+                            inline: bool = False, replica: bool = False,
+                            incarnation: Optional[int] = None):
         """``replica``: this holder is an eager secondary copy (pushed by
         the sealing raylet for availability, not pulled by a consumer) —
         recorded so re-replication math can tell managed copies from
         incidental consumer-side caches.  Striping treats all holders the
-        same, so every replica also doubles a pull's read bandwidth."""
+        same, so every replica also doubles a pull's read bandwidth.
+
+        ``incarnation``: the registering node's stamp — a fenced (dead or
+        stale-incarnation) node cannot resurrect directory entries."""
         with self._lock:
+            if not self._fence_ok(node_id, incarnation):
+                return
             entry = self._objects.setdefault(
                 oid, {"nodes": set(), "size": size, "inline": inline,
                       "replicas": set()})
@@ -897,12 +1298,17 @@ class GcsCore:
     # ----------------------------------------------------------- task events
 
     def add_task_events(self, node_id: str, events: List[dict],
-                        dropped: int = 0):
+                        dropped: int = 0,
+                        incarnation: Optional[int] = None):
         """Batch append from one raylet's export ring buffer.  ``dropped``
         is how many events that raylet shed to backpressure since its last
-        flush (the buffer never blocks dispatch — it drops and counts)."""
+        flush (the buffer never blocks dispatch — it drops and counts).
+        Stamped batches from a fenced node are rejected whole (stale task
+        completions must not overwrite the retried attempts' states)."""
         cap = max(1, config.task_events_max_per_job)
         with self._lock:
+            if not self._fence_ok(node_id, incarnation):
+                return
             self._task_events_dropped += dropped
             last_job, tasks, log = None, None, None
             for ev in events:
@@ -1000,7 +1406,9 @@ class GcsCore:
 
 _OPS = {
     "register_node", "unregister_node", "heartbeat", "nodes", "get_node",
-    "place_task", "feasible_nodes", "load_metrics", "drain_node",
+    "place_task", "feasible_nodes", "load_metrics",
+    "drain_node", "drain_complete", "drain_status",
+    "probe_report", "health_stats",
     "kv_put", "kv_multi_put", "kv_get", "kv_del", "kv_keys",
     "put_function", "get_function",
     "register_actor", "update_actor", "remove_actor", "get_actor",

@@ -318,6 +318,28 @@ def recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
     return True
 
 
+def liveness_ping(address, node_id: str, incarnation: int,
+                  timeout: float) -> bool:
+    """Dial a raylet control listener and verify a ping/pong identity
+    echo: the pong must carry the expected node_id AND incarnation — a
+    recycled port answering, or an older incarnation of the node, is not
+    liveness.  One blocking dial+roundtrip bounded by ``timeout``; shared
+    by the GCS's direct probe and the peer-relayed indirect probe so the
+    two verdicts can never diverge."""
+    timeout = max(0.05, timeout)
+    try:
+        with socket.create_connection(tuple(address),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            send_msg(sock, {"t": "ping"})
+            reply = recv_msg(sock)
+    except (OSError, ProtocolError):
+        return False
+    return (isinstance(reply, dict) and reply.get("t") == "pong"
+            and reply.get("node_id") == node_id
+            and reply.get("incarnation") == incarnation)
+
+
 def recv_msg(sock: socket.socket) -> Optional[Any]:
     header = recv_exact(sock, _HDR)
     if header is None:

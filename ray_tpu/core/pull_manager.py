@@ -92,18 +92,27 @@ class PullManager:
         post: Callable[..., None],
         on_done: Callable[[ObjectID], None],
         on_fail: Callable[[ObjectID, List[str]], None],
+        hello_fn: Optional[Callable[[], Tuple[str, int]]] = None,
     ):
         """``data_addr_fn``: peer node_id -> (host, data_port) or None —
         called on the event thread at request time only.  ``post`` hops a
         closure onto the raylet event loop; ``on_done``/``on_fail`` are
-        delivered through it."""
+        delivered through it.  ``hello_fn`` returns this node's
+        ``(node_id, incarnation)`` — the identity every dialed data
+        channel presents for the holder's incarnation-fencing check."""
         self.node_id = node_id
         self._store_fn = store_fn
         self._data_addr_fn = data_addr_fn
         self._post = post
         self._on_done = on_done
         self._on_fail = on_fail
+        self._hello_fn = hello_fn
         self._lock = make_lock("pull_manager.state")
+        # SUSPECT holders (failure-detector state from node_suspect pubsub):
+        # new pulls put them last in line and active pulls rotate striped
+        # ranges away from them — routing-only, nothing is torn down, so a
+        # false suspicion costs a rebalance, not a failed pull.
+        self._suspect: set = set()                   # guard: _lock
         self._rid = itertools.count(1)
         self._seq = itertools.count()
         self._pulls: Dict[ObjectID, _Pull] = {}      # guard: _lock
@@ -162,6 +171,11 @@ class PullManager:
         locs = [n for n in locations if self._dialable(n)]
         if not locs:
             return False
+        # SUSPECT holders sort last: still usable (a suspicion is not a
+        # death), but healthy holders win the stripe assignments.
+        # unguarded-ok: set membership is GIL-atomic; staleness only
+        # affects ordering.
+        locs.sort(key=lambda n: n in self._suspect)
         cap_src = max(1, config.pull_max_sources)
         need_dial = False
         with self._lock:
@@ -234,8 +248,55 @@ class PullManager:
     def on_node_dead(self, node_id: str):
         with self._lock:
             chan = self._channels.get(node_id)
+            self._suspect.discard(node_id)
         if chan is not None:
             chan.close()  # receiver thread delivers the "closed" event
+
+    def _rotate_range_locked(self, pull, rid, chan, off, ln, others,
+                             now, actions):  # requires: _lock
+        """Reassign one in-flight range DIRECTLY to the least-loaded other
+        holder (the generic assigner could hand the range straight back to
+        the vacated slot) — temporarily exceeding its pipeline depth beats
+        staying on a stalled/suspect source."""
+        chan.cancel(rid)
+        del pull.inflight[rid]
+        self._rid_to_pull.pop(rid, None)
+        self._source_switches += 1
+        other = min(
+            others,
+            key=lambda c: sum(1 for e in pull.inflight.values()
+                              if e[0] is c))
+        new_rid = next(self._rid)
+        pull.inflight[new_rid] = (other, off, ln, now)
+        self._rid_to_pull[new_rid] = pull
+        sink = (pull.dest[off:off + ln]
+                if pull.dest is not None else None)
+        actions.append(("range", other, new_rid, pull.oid, off, ln, sink))
+
+    def on_node_suspect(self, node_id: str, suspect: bool):
+        """Failure-detector routing signal (raylet event thread): a
+        SUSPECT holder's in-flight striped ranges rotate to the pull's
+        other live sources immediately instead of waiting out the stall
+        watchdog; the channel stays open and nothing fails — if the node
+        recovers, it simply starts winning ranges again."""
+        actions = []
+        now = time.monotonic()
+        with self._lock:
+            if not suspect:
+                self._suspect.discard(node_id)
+                return
+            self._suspect.add(node_id)
+            for pull in self._pulls.values():
+                others = [c for c in pull.channels
+                          if c.node_id != node_id and c.alive]
+                if not others:
+                    continue  # sole source: keep it, slow beats dead
+                for rid, (chan, off, ln, _t0) in list(pull.inflight.items()):
+                    if chan.node_id != node_id:
+                        continue
+                    self._rotate_range_locked(pull, rid, chan, off, ln,
+                                              others, now, actions)
+        self._run_actions(actions)
 
     def tick(self):
         """Watchdog (event-thread timer): rotate stalled ranges to another
@@ -265,27 +326,8 @@ class PullManager:
                     others = [c for c in pull.channels
                               if c is not chan and c.alive]
                     if others:
-                        # reassign DIRECTLY to a different holder (the
-                        # generic assigner could hand the range straight
-                        # back to the stalled channel's freed slot) —
-                        # temporarily exceeding its pipeline depth beats
-                        # ping-ponging on the stalled source forever
-                        chan.cancel(rid)
-                        del pull.inflight[rid]
-                        self._rid_to_pull.pop(rid, None)
-                        self._source_switches += 1
-                        other = min(
-                            others,
-                            key=lambda c: sum(1 for e in
-                                              pull.inflight.values()
-                                              if e[0] is c))
-                        new_rid = next(self._rid)
-                        pull.inflight[new_rid] = (other, off, ln, now)
-                        self._rid_to_pull[new_rid] = pull
-                        sink = (pull.dest[off:off + ln]
-                                if pull.dest is not None else None)
-                        actions.append(("range", other, new_rid, pull.oid,
-                                        off, ln, sink))
+                        self._rotate_range_locked(pull, rid, chan, off, ln,
+                                                  others, now, actions)
                     else:
                         stalled_channels.append(chan)
         self._run_actions(actions)
@@ -340,11 +382,14 @@ class PullManager:
                 self._no_data_plane[node] = time.monotonic() + 30.0
                 continue
             chan = None
+            identity = self._hello_fn() if self._hello_fn is not None \
+                else None
             for attempt in range(max(1, config.data_dial_attempts)):
                 if self._closed:
                     return out
                 try:
-                    chan = DataChannel(node, addr, self._on_event)
+                    chan = DataChannel(node, addr, self._on_event,
+                                       identity=identity)
                     break
                 except (ConnectionRefusedError, TimeoutError):
                     # Refused: the peer process is gone.  Timeout: the
@@ -461,6 +506,11 @@ class PullManager:
         actions = []
         depth = max(1, config.pull_pipeline_depth)
         live = [c for c in pull.channels if c.alive]
+        # SUSPECT holders stop winning new ranges while any healthy source
+        # remains (failure-detector routing; a lone suspect still serves).
+        healthy = [c for c in live if c.node_id not in self._suspect]
+        if healthy:
+            live = healthy
         if not live:
             if pull.inflight or not pull.unassigned:
                 return actions

@@ -289,7 +289,7 @@ class _PeerConn:
     def send(self, msg):
         if self.blackholed:
             return
-        fault = _chaos.net_fault("peer")
+        fault = _chaos.net_fault("peer", peer=self.node_id)
         if fault is not None:
             if fault == "blackhole":
                 self.blackholed = True
@@ -431,6 +431,13 @@ class Raylet:
         self.node_ip = node_ip
         self.gcs_address = gcs_address
         self.cluster_mode = listen_port is not None
+        # Registration generation assigned by the GCS (monotonic per
+        # node_id).  Stamped onto heartbeats, directory updates, task-event
+        # batches, actor registrations, peer hellos, and data-channel
+        # handshakes — the fencing token that makes a node declared dead
+        # unable to mutate cluster state until it re-registers fresh
+        # (reference: raylet restarts bump the node instance id).
+        self.incarnation = 0
 
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         if os.path.exists(self.socket_path):
@@ -562,6 +569,19 @@ class Raylet:
         # ---- cluster state (all event-thread owned) ----
         self._peers: Dict[str, _PeerConn] = {}          # node_id -> conn
         self._cluster_nodes: Dict[str, dict] = {}       # node_id -> gcs info
+        # Fenced peers: node_id -> last incarnation declared dead.  Written
+        # on the event thread (node_dead events); read by event-thread
+        # peer-hello checks AND data-server handshake threads (dict get is
+        # GIL-atomic; entries are independent).
+        self._fenced: Dict[str, int] = {}
+        self._m_fenced_frames = 0  # stale peer hellos / handshakes rejected
+        # ---- graceful drain (node_drain push -> drain_complete) ----
+        self._draining = False
+        self._drained = False           # drain finished: stop heartbeating
+        self._drain_deadline = 0.0
+        self._drain_stats: Dict[str, int] = {}
+        self._drain_pushed: set = set()  # oids already pushed during drain
+        self._drain_push_at: Dict[ObjectID, float] = {}  # last push time
         self._forwarded: Dict[TaskID, Tuple[TaskSpec, str]] = {}
         self._actor_owner_cache: Dict[ActorID, str] = {}
         self._pulls: Dict[ObjectID, dict] = {}          # oid -> pull state
@@ -576,11 +596,13 @@ class Raylet:
             from ray_tpu.core.data_channel import DataServer
             from ray_tpu.core.pull_manager import PullManager
 
-            self._data_server = DataServer(node_ip, self._raylet_store)
+            self._data_server = DataServer(node_ip, self._raylet_store,
+                                           fence_fn=self._peer_fence_ok)
             self._pull_manager = PullManager(
                 self.node_id, self._raylet_store, self._peer_data_addr,
                 post=self.call_async,
-                on_done=self._on_pull_done, on_fail=self._on_pull_failed)
+                on_done=self._on_pull_done, on_fail=self._on_pull_failed,
+                hello_fn=lambda: (self.node_id, self.incarnation))
         # Bounded sender pool for the python-fallback pull path (was: one
         # thread spawned per pull request).
         self._pull_send_q: Optional[_queue.SimpleQueue] = None
@@ -614,12 +636,11 @@ class Raylet:
         self.node_labels = _node_topology_labels()
         self.data_port = (self._data_server.port
                           if self._data_server is not None else None)
-        for info in self.gcs.register_node(
-                self.node_id, address, self.resources_total,
-                store_path=store_path, hostname=socket.gethostname(),
-                labels=self.node_labels, data_port=self.data_port):
-            if info["node_id"] != self.node_id and info["alive"]:
-                self._cluster_nodes[info["node_id"]] = info
+        self._apply_registration(self.gcs.register_node(
+            self.node_id, address, self.resources_total,
+            store_path=store_path, hostname=socket.gethostname(),
+            labels=self.node_labels, data_port=self.data_port,
+            incarnation=self.incarnation))
 
         self._thread = threading.Thread(target=self._run, name="raylet", daemon=True)
         self._thread.start()
@@ -1185,8 +1206,33 @@ class Raylet:
         if t == "submit":
             self.submit_task(msg["spec"])
             return
+        if t == "ping":
+            # Liveness probe (GCS direct probe, or a peer relaying an
+            # indirect one): echo identity + incarnation so a recycled
+            # port or a stale incarnation never passes for liveness.
+            try:
+                conn.send({"t": "pong", "node_id": self.node_id,
+                           "incarnation": self.incarnation})
+            except OSError:
+                pass
+            return
         if t == "peer_hello":
-            # Another raylet dialed us: promote the conn to a peer channel.
+            # Another raylet dialed us: promote the conn to a peer channel
+            # — unless it presents a fenced incarnation (a resurrected
+            # partitioned node must re-register before its frames count).
+            inc = msg.get("incarnation")
+            if inc is not None and not self._peer_fence_ok(msg["node_id"],
+                                                           inc):
+                self._workers.pop(conn.sock, None)
+                try:
+                    self._sel.unregister(conn.sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+                return
             peer = _PeerConn(conn.sock, msg["node_id"])
             self._workers.pop(conn.sock, None)
             self._sel.modify(conn.sock, selectors.EVENT_READ, ("peer", peer))
@@ -1334,22 +1380,221 @@ class Raylet:
             shapes[key] = shapes.get(key, 0) + 1
         return [(dict(k), n) for k, n in shapes.items()]
 
+    def _apply_registration(self, snapshot):
+        """Digest a register_node reply: adopt the incarnation the GCS
+        assigned this node and refresh the peer membership view."""
+        for info in snapshot or ():
+            if info["node_id"] == self.node_id:
+                self.incarnation = info.get("incarnation", self.incarnation)
+            elif info["alive"]:
+                self._cluster_nodes[info["node_id"]] = info
+
+    def _register_with_gcs(self):
+        # Proposing the incarnation we last held keeps the assigned one
+        # strictly ABOVE every fence watermark peers may hold for us even
+        # when the GCS lost its counters (restart without persistence).
+        self._apply_registration(self.gcs.register_node(
+            self.node_id, (self.node_ip, self.tcp_port),
+            self.resources_total, store_path=self.store_path,
+            hostname=socket.gethostname(),
+            labels=self.node_labels, data_port=self.data_port,
+            incarnation=self.incarnation))
+
     def _heartbeat(self):
+        if self._drained:
+            return  # drained: this node is retired, stop asserting liveness
         try:
             ok = self.gcs.heartbeat(self.node_id, self.resources_available,
                                     queue_len=len(self._ready_queue),
-                                    pending_shapes=self._pending_demand_shapes())
-            if not ok:
-                # GCS lost track of us (restart / marked dead): re-register.
-                self.gcs.register_node(
-                    self.node_id, (self.node_ip, self.tcp_port),
-                    self.resources_total, store_path=self.store_path,
-                    hostname=socket.gethostname(),
-                    labels=self.node_labels, data_port=self.data_port)
+                                    pending_shapes=self._pending_demand_shapes(),
+                                    incarnation=self.incarnation)
+            if ok == "fenced":
+                # This incarnation was declared dead (partition healed,
+                # long stall): split-brain guard — kill the local workers
+                # and come back as a fresh incarnation.
+                self._on_fenced()
+            elif not ok:
+                # GCS lost track of us (restart): plain re-register.
+                self._register_with_gcs()
         except (ConnectionError, TimeoutError, OSError):
             pass
-        if not self._shutdown:
+        if not self._shutdown and not self._drained:
             self.add_timer(config.gcs_heartbeat_interval_s, self._heartbeat)
+
+    def _on_fenced(self):
+        """The GCS rejected this node's incarnation: some failure detector
+        declared it dead and the cluster may already have restarted its
+        actors and reconstructed its objects elsewhere.  The ONLY safe
+        continuation is to kill every local worker (so no stale actor
+        instance or in-flight task can double-execute side effects or
+        publish stale results) and re-register under a fresh incarnation
+        (reference: a fenced raylet restarts; here the process survives
+        but its execution state does not)."""
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: incarnation "
+            f"{self.incarnation} was fenced (declared dead) — killing "
+            "local workers and re-registering\n")
+        for proc in self._procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        # Worker deaths flow back through the normal socket-EOF path
+        # (task failures/retries, actor restarts per budget) — with a
+        # fresh incarnation those re-assertions are accepted again.
+        try:
+            self._register_with_gcs()
+        except (ConnectionError, TimeoutError, OSError):
+            return  # next heartbeat retries the re-register
+        # Re-publish surviving local store objects: the death declaration
+        # pruned them from the directory, but the bytes are still valid.
+        for oid, st in self._objects.items():
+            if st.status == "store":
+                self._gcs_post("add_object_location", oid.hex(),
+                               self.node_id, st.size or 0,
+                               incarnation=self.incarnation)
+
+    def _peer_fence_ok(self, node_id: str, incarnation: int) -> bool:
+        """Data-server handshake / peer-hello check (any thread): reject a
+        peer presenting an incarnation that was declared dead.  Unknown
+        nodes are accepted — they may simply not have registered yet from
+        this node's point of view."""
+        fenced = self._fenced.get(node_id)
+        if fenced is not None and incarnation <= fenced:
+            self._m_fenced_frames += 1  # unguarded-ok: monotonic stat counter
+            return False
+        return True
+
+    def _relay_probe(self, data: dict):
+        """Indirect liveness probe: the GCS asked THIS raylet to ping a
+        suspect peer it cannot reach itself (covers an asymmetric
+        GCS<->node partition where peers still can).  The blocking dial
+        runs on a throwaway thread — never on the event loop."""
+        gcs = self.gcs
+
+        def run():
+            ok = protocol.liveness_ping(
+                data["address"], data["target"], data["incarnation"],
+                config.gcs_probe_timeout_s)
+            try:
+                gcs.probe_report(data["token"], ok)
+            except (ConnectionError, TimeoutError, OSError):
+                pass  # GCS gone: its waiter times out on its own
+
+        threading.Thread(target=run, name="probe-relay",
+                         daemon=True).start()
+
+    # ------------------------------------------------------ graceful drain
+    # (reference: the autoscaler's DrainNode RPC before instance
+    # termination.)  The GCS flipped this node's `draining` flag before
+    # pushing node_drain, so no NEW placement lands here; the raylet then
+    # (1) checkpoint-and-relocates checkpointable actors, (2) pushes
+    # sole-copy store objects to surviving nodes via the replication path,
+    # (3) waits for running tasks — all bounded by the drain deadline —
+    # and reports drain_complete, which retires the node with ZERO
+    # reconstructions.
+
+    def _on_drain_request(self, timeout_s: float):
+        if self._draining or self._shutdown:
+            return
+        self._draining = True
+        self._drain_deadline = time.monotonic() + max(0.5, timeout_s)
+        self._drain_stats = {"objects_migrated": 0, "actors_relocated": 0,
+                             "deadline_hit": 0}
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: draining "
+            f"(deadline {timeout_s:.1f}s)\n")
+        # Checkpointable actors executing here: final checkpoint + graceful
+        # exit; the restart re-places elsewhere (the GCS skips draining
+        # nodes) and restores warm.  Non-checkpointable actors ride the
+        # node-death path at completion like a crash would, minus the
+        # detection latency.
+        for aid, actor in list(self._actors.items()):
+            if (actor.conn is not None
+                    and actor.creation_spec.checkpoint_interval > 0):
+                self._drain_stats["actors_relocated"] += 1
+                self.kill_actor(aid, no_restart=False)
+        self._drain_push_objects()
+        self.add_timer(0.2, self._drain_tick)
+
+    def _drain_sole_copies(self) -> List[ObjectID]:
+        """Local store objects the directory lists no OTHER holder for —
+        the set whose bytes die with this node unless migrated."""
+        held = [oid for oid, st in self._objects.items()
+                if st.status == "store"]
+        if not held:
+            return []
+        locs = self._gcs_err_ok(self.gcs.get_object_locations_batch,
+                                [o.hex() for o in held])
+        if locs is _GCS_ERR:
+            return held  # can't tell: keep pushing until the GCS answers
+        sole = []
+        for oid in held:
+            nodes = set((locs or {}).get(oid.hex(), {}).get("nodes", ()))
+            nodes.discard(self.node_id)
+            if not nodes:
+                sole.append(oid)
+        return sole
+
+    def _drain_push_objects(self, sole: Optional[List[ObjectID]] = None):
+        now = time.monotonic()
+        if sole is None:
+            sole = self._drain_sole_copies()
+        for oid in sole:
+            st = self._objects.get(oid)
+            if st is None or st.status != "store":
+                continue
+            last = self._drain_push_at.get(oid)
+            if last is not None and now - last < 1.0:
+                continue  # a push is in flight; give the pull a second
+            if last is not None:
+                # the previous push never registered a copy (lost frame,
+                # dead target): the directory says we are still the sole
+                # holder, so every recorded replica is unconfirmed — clear
+                # them so the retry may pick the same target again
+                st.replicas = []
+            self._drain_push_at[oid] = now
+            if oid not in self._drain_pushed:
+                self._drain_pushed.add(oid)
+                self._drain_stats["objects_migrated"] += 1
+            # force one extra copy regardless of size threshold; the
+            # drain tick re-pushes if the target never registered it
+            st.replicated = False
+            self._replicate_object(oid, st, 1)
+
+    def _drain_tick(self):
+        if self._shutdown or not self._draining or self._drained:
+            return
+        tasks_running = any(c.inflight for c in self._workers.values())
+        actors_here = any(a.conn is not None
+                          for a in self._actors.values())
+        sole = self._drain_sole_copies()
+        deadline_hit = time.monotonic() >= self._drain_deadline
+        if (sole or tasks_running or actors_here
+                or self._ready_queue) and not deadline_hit:
+            if sole:
+                self._drain_push_objects(sole)  # re-push stragglers
+            self.add_timer(0.2, self._drain_tick)
+            return
+        if deadline_hit and (sole or tasks_running or actors_here):
+            self._drain_stats["deadline_hit"] = 1
+        self._finish_drain()
+
+    def _finish_drain(self):
+        self._drained = True
+        stats = dict(self._drain_stats)
+        sys.stderr.write(
+            f"[ray_tpu] node {self.node_id[:8]}: drain complete {stats}\n")
+        self._gcs_safe(self.gcs.drain_complete, self.node_id, stats)
+        # A drained node is retired: shut the raylet down (the autoscaler
+        # terminates the instance; in tests the process exits cleanly).
+        self._shutdown = True
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+        if self.on_fatal is not None:
+            self._safe(self.on_fatal)
 
     def _gcs_push(self, event: str, data):
         """Runs on the GCS client/reader thread — hop to the event loop."""
@@ -1421,15 +1666,32 @@ class Raylet:
         except (ConnectionError, TimeoutError, OSError):
             self._on_gcs_lost()
             return
-        self._gcs_safe(self.gcs.register_node,
-                       self.node_id, (self.node_ip, self.tcp_port),
-                       self.resources_total, store_path=self.store_path,
-                       hostname=socket.gethostname(),
-                       labels=self.node_labels, data_port=self.data_port)
+        # Ask BEFORE re-registering whether this incarnation was declared
+        # dead while we were away (the fence record survives GCS restarts
+        # even though membership does not): a fenced zombie must kill its
+        # stale workers first — re-registering and re-asserting its actors
+        # straight away could double-execute against the replacements the
+        # cluster started during the outage.
+        hb = self._gcs_safe(self.gcs.heartbeat, self.node_id,
+                            self.resources_available,
+                            incarnation=self.incarnation)
+        if hb == "fenced":
+            self._on_fenced()  # kills workers, re-registers fresh,
+            return             # re-publishes surviving store objects
+        snapshot = self._gcs_safe(
+            self.gcs.register_node,
+            self.node_id, (self.node_ip, self.tcp_port),
+            self.resources_total, store_path=self.store_path,
+            hostname=socket.gethostname(),
+            labels=self.node_labels, data_port=self.data_port,
+            incarnation=self.incarnation)
+        if snapshot is not None:
+            self._apply_registration(snapshot)
         for oid, st in self._objects.items():
             if st.status == "store":
                 self._gcs_safe(self.gcs.add_object_location,
-                               oid.hex(), self.node_id, size=st.size or 0)
+                               oid.hex(), self.node_id, size=st.size or 0,
+                               incarnation=self.incarnation)
         # Reconcile actor state: the restarted GCS loaded persisted actors
         # as "restarting" (it cannot know which survived); every actor
         # LIVE on this node re-asserts itself.
@@ -1445,9 +1707,51 @@ class Raylet:
             nid = data["node_id"]
             if nid != self.node_id:
                 self._cluster_nodes[nid] = data
+                inc = data.get("incarnation")
+                if inc is not None and self._fenced.get(nid, -1) < inc:
+                    # the node came back under a fresh incarnation: the
+                    # fence applies to the OLD generation only
+                    self._fenced.pop(nid, None)
             self._schedule()
         elif event == "node_dead":
-            self._on_node_death(data["node_id"], data.get("reason", ""))
+            nid = data["node_id"]
+            inc = data.get("incarnation")
+            if inc is not None:
+                prev = self._fenced.get(nid)
+                if prev is None or inc > prev:
+                    self._fenced[nid] = inc
+            if nid == self.node_id:
+                # Our own death declaration (drain completion, or a fence
+                # we will learn about via the next rejected heartbeat) —
+                # not a peer to clean up after.
+                return
+            self._on_node_death(nid, data.get("reason", ""))
+        elif event == "node_suspect":
+            nid = data["node_id"]
+            suspect = bool(data.get("suspect"))
+            info = self._cluster_nodes.get(nid)
+            if info is not None:
+                info["suspect"] = suspect
+            if self._pull_manager is not None:
+                # striped pulls rotate away from suspect holders (and
+                # rotate back on recovery) — routing, not recovery:
+                # reconstruction/replication repair fire only on DEAD
+                self._pull_manager.on_node_suspect(nid, suspect)
+            if not suspect:
+                self._schedule()  # recovered: it can take work again
+        elif event == "node_probe":
+            self._relay_probe(data)
+        elif event == "node_drain":
+            nid = data.get("node_id")
+            if nid == self.node_id:
+                self._on_drain_request(float(data.get("timeout_s") or
+                                             config.drain_timeout_s))
+            else:
+                # A peer is leaving: stop treating it as a replication /
+                # locality-forwarding target while its objects migrate off.
+                info = self._cluster_nodes.get(nid)
+                if info is not None:
+                    info["draining"] = True
         elif event == "object_at":
             oid = ObjectID.from_hex(data["oid"])
             st = self._objects.get(oid)
@@ -1658,7 +1962,8 @@ class Raylet:
         peer = _PeerConn(sock, node_id)
         self._peers[node_id] = peer
         self._sel.register(sock, selectors.EVENT_READ, ("peer", peer))
-        peer.send({"t": "peer_hello", "node_id": self.node_id})
+        peer.send({"t": "peer_hello", "node_id": self.node_id,
+                   "incarnation": self.incarnation})
         return peer
 
     def _on_peer_readable(self, peer: _PeerConn):
@@ -2622,6 +2927,9 @@ class Raylet:
             | set(st.replicas or ()) | set(exclude)
         cands = [n for n, info in self._cluster_nodes.items()
                  if n not in have and info.get("alive", True)
+                 # never push availability copies at a node that is itself
+                 # suspected dead or being drained away
+                 and not info.get("suspect") and not info.get("draining")
                  # a node registered WITHOUT a store can't hold a replica
                  # (node_added pushes lack the key: treat unknown as ok)
                  and (info.get("store_path") or "store_path" not in info)]
@@ -3009,7 +3317,8 @@ class Raylet:
         self._set_contains(st, contains)
         if self.cluster_mode:
             self._gcs_post("add_object_location", oid.hex(),
-                           self.node_id, len(blob), inline=True)
+                           self.node_id, len(blob), inline=True,
+                           incarnation=self.incarnation)
         self._object_ready(oid)
 
     def _object_in_store(self, oid: ObjectID, contains=None):
@@ -3025,7 +3334,8 @@ class Raylet:
             st.replicated = True
         if self.cluster_mode:
             self._gcs_post("add_object_location", oid.hex(),
-                           self.node_id, st.size, replica=replica)
+                           self.node_id, st.size, replica=replica,
+                           incarnation=self.incarnation)
         self._object_ready(oid)
 
     def _object_error(self, oid: ObjectID, err: Exception):
@@ -3117,7 +3427,8 @@ class Raylet:
                     ok = self._gcs_safe(
                         self.gcs.register_actor, spec.actor_id.binary(),
                         self.node_id, name=actor.name, namespace=namespace,
-                        spec_blob=_cp.dumps(spec) if actor.name else None)
+                        spec_blob=_cp.dumps(spec) if actor.name else None,
+                        incarnation=self.incarnation)
                     if ok is False:
                         del self._actors[spec.actor_id]
                         err = ValueError(
@@ -3367,6 +3678,22 @@ class Raylet:
                     if not self._forward_task(spec, aff):
                         deferred.append(spec)
                         no_progress += 1
+                    continue
+                # Draining: nothing new dispatches locally — forward
+                # everything placeable to a surviving node (the GCS
+                # placement already skips this node), so the drain
+                # quiesces instead of re-filling.  Unforwardable work
+                # defers and rides the drain deadline.
+                if (self._draining and not placement.get("pg")
+                        and spill_queries < 32):
+                    spill_queries += 1
+                    target = self._gcs_safe(
+                        self.gcs.place_task, spec.resources or {},
+                        exclude=[self.node_id])
+                    if target and self._forward_task(spec, target):
+                        continue
+                    deferred.append(spec)
+                    no_progress += 1
                     continue
                 # Locality-aware placement (reference: locality_aware lease
                 # policy): a task whose arguments hold more bytes on a peer
@@ -3629,7 +3956,7 @@ class Raylet:
         if best_bytes < min_bytes or best_bytes <= local:
             return None
         info = self._cluster_nodes.get(best)
-        if info is None:
+        if info is None or info.get("suspect") or info.get("draining"):
             return None
         total = info.get("resources_total")
         # node_added pushes carry only id+address; with capacity unknown,
@@ -4436,7 +4763,8 @@ class Raylet:
         events = list(self._task_event_buf)
         self._task_event_buf.clear()
         dropped, self._task_event_dropped = self._task_event_dropped, 0
-        self._gcs_post("add_task_events", self.node_id, events, dropped)
+        self._gcs_post("add_task_events", self.node_id, events, dropped,
+                       incarnation=self.incarnation)
 
     def _task_event_flush_tick(self):
         # One-shot timer, re-armed lazily by the next _record_event: an
@@ -4584,6 +4912,12 @@ class Raylet:
                 "ray_tpu_internal_checkpoint_restores_total",
                 "Actor restarts that restored from a checkpoint instead "
                 "of starting cold"),
+            # ---- failure detection / fencing ----
+            "fenced_frames": counter(
+                "ray_tpu_internal_fenced_frames_total",
+                "Stale node-attributed frames rejected by incarnation "
+                "fencing (peer hellos / data-channel handshakes from a "
+                "declared-dead incarnation)"),
         }
         self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
         if isinstance(self.gcs, GcsClient):
@@ -4660,6 +4994,7 @@ class Raylet:
         bump(im["ckpt_saves"], "ckpt_saves", self._m_ckpt_saves)
         bump(im["ckpt_bytes"], "ckpt_bytes", self._m_ckpt_bytes)
         bump(im["ckpt_restores"], "ckpt_restores", self._m_ckpt_restores)
+        bump(im["fenced_frames"], "fenced_frames", self._m_fenced_frames)
         if self._pull_manager is not None:
             ps = self._pull_manager.stats()
             im["pull_inflight_bytes"].set(ps["inflight_bytes"])

@@ -97,6 +97,46 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """Gracefully drain a node out of the cluster (reference: the
+    autoscaler's DrainNode RPC): placement stops immediately, sole-copy
+    store objects migrate to surviving nodes, checkpointable actors
+    checkpoint-and-relocate, running tasks get up to the deadline — then
+    the node retires with ZERO reconstructions."""
+    import time as _time
+
+    from ray_tpu.core.gcs import GcsClient
+
+    cli = GcsClient(args.address)
+    try:
+        node_id = args.node_id
+        matches = [n["node_id"] for n in cli.nodes()
+                   if n["alive"] and n["node_id"].startswith(node_id)]
+        if len(matches) != 1:
+            print(f"error: node id prefix {node_id!r} matches "
+                  f"{len(matches)} alive node(s)", file=sys.stderr)
+            return 2
+        node_id = matches[0]
+        if not cli.drain_node(node_id, timeout_s=args.timeout):
+            print(f"error: node {node_id} unknown or already dead",
+                  file=sys.stderr)
+            return 1
+        print(f"draining {node_id} (deadline {args.timeout:.0f}s)")
+        if args.no_wait:
+            return 0
+        deadline = _time.monotonic() + args.timeout + 10.0
+        while _time.monotonic() < deadline:
+            status = cli.drain_status(node_id)
+            if status.get("state") == "drained":
+                print(f"drained: {json.dumps(status)}")
+                return 0
+            _time.sleep(0.5)
+        print("drain did not complete in time", file=sys.stderr)
+        return 1
+    finally:
+        cli.close()
+
+
 def cmd_list(args) -> int:
     _connect(args)
     from ray_tpu.util import state
@@ -282,6 +322,16 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="cluster resource summary")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("drain", help="gracefully drain a node (migrate "
+                                     "objects/actors, then retire it)")
+    p.add_argument("node_id", help="node id (unique prefix accepted)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="drain deadline seconds (default 30)")
+    p.add_argument("--no-wait", action="store_true",
+                   help="start the drain and return immediately")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("list", help="state tables")
     p.add_argument("what", choices=["nodes", "actors", "tasks"])

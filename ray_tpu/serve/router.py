@@ -17,7 +17,15 @@ import time
 from collections import OrderedDict
 from typing import Any, List, Optional
 
+from ray_tpu.core.config import config
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE
+
+config.define("serve_probe_timeout_s", float, 1.0,
+              "Queue-length probe timeout on the request routing path.  "
+              "Was 5 s: a dead or partitioned replica then stalled every "
+              "request that sampled it for the full window; with "
+              "suspicion-based liveness a short probe plus immediate "
+              "local exclusion re-picks in about a second worst-case.")
 
 
 class _DeploymentRouting:
@@ -101,6 +109,56 @@ class _DeploymentRouting:
 _routing: dict = {}
 _routing_lock = threading.Lock()
 
+#: Short-TTL cache of cluster liveness for the routing hot path: node ids
+#: that are SUSPECT (missed heartbeats, probe pending) or dead.  Replicas
+#: hosted there are excluded from picks immediately — routing around a
+#: suspect costs nothing, while probing into one costs a timeout.
+_unhealthy_nodes_cache: dict = {"at": 0.0, "nodes": frozenset()}
+_unhealthy_nodes_lock = threading.Lock()
+_UNHEALTHY_TTL_S = 1.0
+
+
+def _unhealthy_nodes() -> frozenset:
+    now = time.monotonic()
+    with _unhealthy_nodes_lock:
+        if now - _unhealthy_nodes_cache["at"] < _UNHEALTHY_TTL_S:
+            return _unhealthy_nodes_cache["nodes"]
+        _unhealthy_nodes_cache["at"] = now  # claim the refresh window
+    try:
+        from ray_tpu.core.worker import global_worker
+
+        nodes = frozenset(
+            n["node_id"] for n in global_worker().gcs_nodes()
+            if not n.get("alive", True) or n.get("suspect")
+            or n.get("draining"))
+    except Exception:  # noqa: BLE001 — liveness view is best-effort
+        nodes = frozenset()
+    with _unhealthy_nodes_lock:
+        _unhealthy_nodes_cache["nodes"] = nodes
+    return nodes
+
+
+def _replica_nodes(replicas) -> dict:
+    """Map replica handle -> hosting node id via the actor table (one GCS
+    round trip, only consulted when some node is unhealthy)."""
+    try:
+        from ray_tpu.core.worker import global_worker
+
+        w = global_worker()
+        if w.mode == "driver":
+            table = w.raylet.gcs.list_actors()
+        elif w.mode == "client":
+            table = w.gcs.list_actors()
+        elif w.mode == "worker":
+            table = w._request("gcs_list_actors")
+        else:
+            return {}
+        by_id = {a["actor_id"]: a.get("exec_node") or a.get("owner_node")
+                 for a in table}
+        return {r: by_id.get(r._actor_id.hex()) for r in replicas}
+    except Exception:  # noqa: BLE001
+        return {}
+
 
 def _routing_for(deployment: str) -> _DeploymentRouting:
     with _routing_lock:
@@ -150,11 +208,22 @@ class DeploymentHandle:
     def _refresh(self, force: bool = False):
         self._routing.refresh(force)
 
-    def _pick_replica(self):
-        """Power-of-two-choices (reference `router.py:639`): sample two,
-        probe in-flight counts, route to the less loaded."""
-        import ray_tpu
+    def _exclude_replicas(self, bad: List[Any]):
+        """Drop failed replicas from the SHARED routing table immediately
+        — every handle of this deployment skips them until the next
+        controller push re-asserts membership."""
+        if not bad:
+            return
+        routing = self._routing
+        with routing.lock:
+            routing.replicas = [r for r in routing.replicas
+                                if r not in bad]
 
+    def _live_replicas(self):
+        """Current replica set minus SUSPECT/dead/draining hosts.  The
+        liveness filter is advisory: when it would empty the set (every
+        host suspect — likely a detector blip) the unfiltered set wins,
+        availability over purity."""
         routing = self._routing
         self._refresh()
         with routing.lock:
@@ -168,29 +237,67 @@ class DeploymentHandle:
             self._refresh(force=True)
             with routing.lock:
                 replicas = list(routing.replicas)
-        if len(replicas) == 1:
-            a, b = replicas[0], None
-        else:
-            a, b = random.sample(replicas, 2)
-        # The probe doubles as a liveness check: a cached-but-dead replica
-        # (e.g. just replaced by an in-place redeploy) errors here and we
-        # refetch the table instead of handing the caller a dead ref.
-        try:
-            if b is None:
-                ray_tpu.get(a.get_queue_len.remote(), timeout=5.0)
-                return a
-            qa, qb = ray_tpu.get(
-                [a.get_queue_len.remote(), b.get_queue_len.remote()],
-                timeout=5.0)
-        except Exception:  # noqa: BLE001 - stale replica: refetch, retry once
-            self._refresh(force=True)
-            with routing.lock:
-                replicas = list(routing.replicas)
-            if not replicas:
-                raise RuntimeError(
-                    f"deployment {self._deployment!r} lost its replicas")
-            return random.choice(replicas)
-        return a if qa <= qb else b
+        unhealthy = _unhealthy_nodes()
+        if unhealthy:
+            hosts = _replica_nodes(replicas)
+            healthy = [r for r in replicas
+                       if hosts.get(r) not in unhealthy]
+            if healthy:
+                return healthy
+        return replicas
+
+    def _pick_replica(self):
+        """Power-of-two-choices (reference `router.py:639`): sample two,
+        probe in-flight counts, route to the less loaded.  Probes are
+        SHORT (serve_probe_timeout_s, default 1 s — was a routing-stalling
+        5 s) and a probe failure excludes the replica from the shared
+        routing table immediately before re-picking; replicas on SUSPECT
+        hosts are never sampled in the first place."""
+        import ray_tpu
+
+        timeout = max(0.1, config.serve_probe_timeout_s)
+        for _attempt in range(3):
+            replicas = self._live_replicas()
+            if len(replicas) == 1:
+                a, b = replicas[0], None
+            else:
+                a, b = random.sample(replicas, 2)
+            pair = [a] if b is None else [a, b]
+            refs = [r.get_queue_len.remote() for r in pair]
+            try:
+                if b is None:
+                    ray_tpu.get(refs[0], timeout=timeout)
+                    return a
+                qa, qb = ray_tpu.get(refs, timeout=timeout)
+                return a if qa <= qb else b
+            except Exception:  # noqa: BLE001 — dead/stale/stalled replica
+                # Identify the failure (the batched get hides which ref
+                # errored): anything not resolved within a grace beat is
+                # treated as dead and excluded NOW — later controller
+                # pushes re-add survivors.
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=0.2)
+                bad = []
+                for r, ref in zip(pair, refs):
+                    if ref not in done:
+                        bad.append(r)
+                        continue
+                    try:
+                        ray_tpu.get(ref, timeout=0.1)
+                    except Exception:  # noqa: BLE001
+                        bad.append(r)
+                # NOT followed by a forced refresh: a refetch would just
+                # re-add the corpse from the controller's not-yet-updated
+                # table — the exclusion stands until the next controller
+                # PUSH re-asserts membership (and _live_replicas force-
+                # refreshes on its own if the set empties).  An empty
+                # ``bad`` means every probe resolved fine, just late
+                # (loaded-but-healthy replicas): retry without evicting.
+                self._exclude_replicas(bad)
+        # three strikes: hand out an unprobed member rather than failing —
+        # the call itself surfaces the error if the replica is truly gone
+        replicas = self._live_replicas()
+        return random.choice(replicas)
 
     # ------------------------------------------------------------- calling
 

@@ -33,6 +33,23 @@ Reference analogue: `python/ray/_private/test_utils.py:1400`
     A fault decision sequence is fully determined by (seed, sequence of
     ``net_fault`` calls), so a single-threaded workload replays exactly;
     multi-threaded callers still get a reproducible fault MIX.
+
+    * **Partitions** — deterministic blackholing between THIS process and
+      a named peer (or every peer, ``"*"``), in one or both directions:
+      ``net().partition(peer, direction="both"|"out"|"in")`` then
+      ``net().heal(peer)`` restores the link.  ``direction`` is relative
+      to this process: ``out`` swallows frames it sends toward the peer,
+      ``in`` swallows frames arriving from it (the data server drops the
+      peer's requests).  Unlike the probabilistic ``blackhole`` fault —
+      which latches the connection dead at the call site — partition
+      drops are decided per frame, so ``heal()`` genuinely restores
+      traffic on the same sockets (partition → resurrect scenarios).
+      Partitions apply to every channel unless ``channels=`` narrows
+      them.  Spawned processes are steered through a control FILE
+      (``RAY_TPU_CHAOS_NET_PARTITION_FILE``): JSON
+      ``{"partitions": {"<peer-or-*>": "<direction>"}}``, re-read at
+      most every 50 ms, so a test driver can partition and heal a live
+      raylet process by rewriting the file.
 """
 
 from __future__ import annotations
@@ -63,6 +80,13 @@ config.define("chaos_net_channels", str, "data",
               "'data').  Defaults to data only — peer control frames "
               "have no per-frame retry, so dropping them is an explicit "
               "opt-in.", live=True)
+config.define("chaos_net_partition_file", str, "",
+              "Network chaos: path of a JSON control file "
+              "({'partitions': {'<peer-node-id-or-*>': "
+              "'both'|'out'|'in'}}) steering deterministic per-peer "
+              "partitions in THIS process.  Re-read at most every 50 ms, "
+              "so a test driver partitions and heals a spawned raylet by "
+              "rewriting the file.  Empty disables.", live=True)
 
 __all__ = ["NodeKiller", "NetworkChaos", "net_fault", "configure_net",
            "net"]
@@ -129,11 +153,13 @@ class NetworkChaos:
     fixed seed gives a reproducible fault sequence."""
 
     __slots__ = ("enabled", "seed", "drop_p", "delay_p", "delay_s",
-                 "blackhole_p", "channels", "_rng", "_lock", "faults")
+                 "blackhole_p", "channels", "_rng", "_lock", "faults",
+                 "partitions", "partition_file", "_pfile_at")
 
     def __init__(self, drop_p: float = 0.0, delay_p: float = 0.0,
                  delay_ms: float = 0.0, blackhole_p: float = 0.0,
-                 seed: int = 0, channels: Optional[List[str]] = None):
+                 seed: int = 0, channels: Optional[List[str]] = None,
+                 partition_file: Optional[str] = None):
         self.drop_p = max(0.0, drop_p)
         self.delay_p = max(0.0, delay_p)
         self.delay_s = max(0.0, delay_ms) / 1e3
@@ -153,7 +179,14 @@ class NetworkChaos:
         self._rng = random.Random(seed)  # guard: _lock
         self._lock = make_lock("chaos.net")
         # injected-fault counts by kind, for test assertions
-        self.faults = {"drop": 0, "delay": 0, "blackhole": 0}
+        self.faults = {"drop": 0, "delay": 0, "blackhole": 0,
+                       "partition": 0}
+        # peer node_id (or "*") -> {"direction", "channels"} — see
+        # partition()/heal().  Partition drops are deterministic (no RNG
+        # draw) so heal() restores traffic exactly.
+        self.partitions: dict = {}  # guard: _lock
+        self.partition_file = partition_file or None
+        self._pfile_at = 0.0  # last control-file refresh  # guard: _lock
 
     @classmethod
     def from_env(cls) -> "NetworkChaos":
@@ -164,11 +197,83 @@ class NetworkChaos:
                    delay_p=config.chaos_net_delay_p,
                    delay_ms=config.chaos_net_delay_ms,
                    blackhole_p=config.chaos_net_blackhole_p,
-                   seed=config.chaos_net_seed, channels=channels)
+                   seed=config.chaos_net_seed, channels=channels,
+                   partition_file=config.chaos_net_partition_file or None)
 
-    def decide(self, channel: str) -> Optional[str]:
+    # ---- deterministic per-peer partitions -------------------------------
+
+    def partition(self, peer: str = "*", direction: str = "both",
+                  channels: Optional[List[str]] = None):
+        """Blackhole traffic between this process and ``peer`` (a node id,
+        or ``"*"`` for every peer).  ``direction`` is relative to THIS
+        process: ``out`` (frames we send toward the peer), ``in`` (frames
+        arriving from it), or ``both``.  Applies to every chaos-hooked
+        channel unless ``channels`` narrows it."""
+        if direction not in ("both", "out", "in"):
+            raise ValueError(f"direction {direction!r} not in both/out/in")
+        with self._lock:
+            self.partitions[peer] = {
+                "direction": direction,
+                "channels": frozenset(channels) if channels else None,
+            }
+
+    def heal(self, peer: Optional[str] = None):
+        """Restore the link to ``peer`` (or every partitioned peer)."""
+        with self._lock:
+            if peer is None:
+                self.partitions.clear()
+            else:
+                self.partitions.pop(peer, None)
+
+    def _refresh_partitions_locked(self):  # requires: _lock
+        """Re-read the control file (test driver -> spawned process
+        steering), at most every 50 ms."""
+        now = time.monotonic()
+        if now - self._pfile_at < 0.05:
+            return
+        self._pfile_at = now
+        import json
+        try:
+            with open(self.partition_file) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            return  # missing/garbled file: keep the last applied state
+        entries = spec.get("partitions") or {}
+        self.partitions = {
+            peer: {"direction": direction
+                   if direction in ("both", "out", "in") else "both",
+                   "channels": None}
+            for peer, direction in entries.items()
+        }
+
+    def _partitioned_locked(self, channel: str, peer: Optional[str],  # requires: _lock
+                            direction: str) -> bool:
+        for key in (peer, "*"):
+            if key is None:
+                continue
+            ent = self.partitions.get(key)
+            if ent is None:
+                continue
+            if ent["channels"] is not None and channel not in ent["channels"]:
+                continue
+            if ent["direction"] in ("both", direction):
+                return True
+        return False
+
+    def decide(self, channel: str, peer: Optional[str] = None,
+               direction: str = "out") -> Optional[str]:
         """Draw a fault for one frame on ``channel``:
-        None | "drop" | "delay" | "blackhole"."""
+        None | "drop" | "delay" | "blackhole".  Partition drops are
+        checked first and are deterministic (no RNG draw — replay
+        sequences are unchanged by partition windows)."""
+        if self.partition_file \
+                or self.partitions:  # unguarded-ok: empty-check fast path; re-checked under _lock below
+            with self._lock:
+                if self.partition_file:
+                    self._refresh_partitions_locked()
+                if self._partitioned_locked(channel, peer, direction):
+                    self.faults["partition"] += 1
+                    return "drop"
         if not self.enabled or channel not in self.channels:
             return None
         with self._lock:
@@ -207,15 +312,19 @@ def configure_net(**kwargs) -> NetworkChaos:
     return _net
 
 
-def net_fault(channel: str) -> Optional[str]:
-    """Hot-path hook: a fault decision for one outbound frame, or None.
-    Costs one attribute check when chaos is disabled."""
+def net_fault(channel: str, peer: Optional[str] = None,
+              direction: str = "out") -> Optional[str]:
+    """Hot-path hook: a fault decision for one frame, or None.  Costs a
+    few attribute checks when chaos is disabled.  ``peer``/``direction``
+    feed the deterministic partition check (see NetworkChaos.partition);
+    probabilistic faults ignore them."""
     n = _net
     if n is None:
         n = net()
-    if not n.enabled:
+    if not n.enabled and not n.partition_file \
+            and not n.partitions:  # unguarded-ok: empty-check fast path; decide() re-checks under _lock
         return None
-    fault = n.decide(channel)
+    fault = n.decide(channel, peer=peer, direction=direction)
     if fault == "delay":
         time.sleep(n.delay_s)
         return None  # the frame still goes out, late

@@ -52,7 +52,11 @@ def list_nodes() -> List[Dict[str, Any]]:
     return [
         {
             "node_id": n["node_id"],
-            "state": "ALIVE" if n.get("alive", True) else "DEAD",
+            "state": ("DEAD" if not n.get("alive", True)
+                      else "DRAINING" if n.get("draining")
+                      else "SUSPECT" if n.get("suspect")
+                      else "ALIVE"),
+            "incarnation": n.get("incarnation", 0),
             "address": n.get("address"),
             "hostname": n.get("hostname", ""),
             "resources_total": n.get("resources_total", {}),
@@ -82,6 +86,7 @@ def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
             "state": a.get("state", "?").upper(),
             "name": a.get("name"),
             "owner_node": a.get("owner_node"),
+            "node_id": a.get("exec_node") or a.get("owner_node"),
         }
     for aid, a in local.items():
         entry = out.setdefault(aid, {"actor_id": aid})

@@ -1,0 +1,374 @@
+"""Suspicion-based liveness, incarnation fencing, and drain plumbing.
+
+Reference behaviors: the GCS health-check manager's ping layer over
+heartbeats (`gcs_health_check_manager.h` — probe before declaring death),
+node instance-id fencing (a raylet restart bumps the node's generation so
+stale frames are rejectable), and the autoscaler's DrainNode RPC.
+
+These are fast GcsCore-level tests: the "raylet" side is a socket
+listener the test controls, so suspicion/probe/fence transitions are
+deterministic without process churn.  Cluster-level partition and drain
+scenarios live in test_chaos.py / test_drain.py.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core import protocol
+from ray_tpu.core.config import config
+from ray_tpu.core.gcs import GcsCore
+
+
+class FakeRaylet:
+    """Minimal probe target: answers {"t": "ping"} with a pong carrying
+    the configured node identity, while ``answering`` is on."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.incarnation = 0
+        self.answering = True
+        self.listener = socket.create_server(("127.0.0.1", 0))
+        self.address = ("127.0.0.1", self.listener.getsockname()[1])
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                sock, _ = self.listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        try:
+            msg = protocol.recv_msg(sock)
+            if (isinstance(msg, dict) and msg.get("t") == "ping"
+                    and self.answering):
+                protocol.send_msg(sock, {"t": "pong",
+                                         "node_id": self.node_id,
+                                         "incarnation": self.incarnation})
+        except (OSError, protocol.ProtocolError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def fast_detection(monkeypatch):
+    monkeypatch.setattr(config, "gcs_heartbeat_interval_s", 0.1)
+    monkeypatch.setattr(config, "gcs_node_suspect_s", 0.25)
+    monkeypatch.setattr(config, "gcs_node_timeout_s", 5.0)
+    monkeypatch.setattr(config, "gcs_probe_timeout_s", 0.2)
+
+
+def _wait(predicate, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_suspect_probe_success_resets(fast_detection):
+    """A silent-but-alive node (GC pause, load) is marked SUSPECT and
+    probed — the successful probe clears the suspicion with ZERO recovery
+    actions, where the old detector would have declared it dead."""
+    g = GcsCore()
+    fake = FakeRaylet("n1")
+    try:
+        snap = g.register_node("n1", fake.address, {"CPU": 1})
+        fake.incarnation = next(n["incarnation"] for n in snap
+                                if n["node_id"] == "n1")
+        events = []
+        g.subscribe(lambda ev, data: events.append((ev, data)))
+        g.start_health_monitor()
+        # never heartbeat: suspicion fires, probes keep it alive
+        assert _wait(lambda: g.health_stats()["suspects_total"] >= 1)
+        assert _wait(
+            lambda: g.health_stats()["false_suspects_total"] >= 1)
+        info = g.get_node("n1")
+        assert info["alive"] and not info["suspect"]
+        assert g.health_stats()["deaths_detected_total"] == 0
+        # SUSPECT + recovery both rode the node-change pubsub
+        kinds = [(ev, d.get("suspect")) for ev, d in events
+                 if ev == "node_suspect"]
+        assert ("node_suspect", True) in kinds
+        assert ("node_suspect", False) in kinds
+    finally:
+        fake.close()
+        g.stop()
+
+
+def test_probe_failure_confirms_death_fast(fast_detection):
+    """Probe failure declares DEAD well under the hard heartbeat timeout
+    (5s here): suspicion (~0.25s) + one failed probe round."""
+    g = GcsCore()
+    fake = FakeRaylet("n1")
+    fake.answering = False
+    try:
+        g.register_node("n1", fake.address, {"CPU": 1})
+        g.start_health_monitor()
+        t0 = time.monotonic()
+        assert _wait(lambda: not g.get_node("n1")["alive"], timeout=4.0)
+        assert time.monotonic() - t0 < 2.5
+        stats = g.health_stats()
+        assert stats["probe_confirmed_deaths_total"] == 1
+        assert stats["deaths_detected_total"] == 1
+        assert stats["time_to_detect_p50_s"] is not None
+        assert stats["time_to_detect_p50_s"] < 2.5
+    finally:
+        fake.close()
+        g.stop()
+
+
+def test_probe_rejects_wrong_identity(fast_detection):
+    """A pong echoing the wrong node id (recycled port) or a stale
+    incarnation is NOT liveness — the node still dies."""
+    g = GcsCore()
+    fake = FakeRaylet("somebody-else")
+    try:
+        g.register_node("n1", fake.address, {"CPU": 1})
+        g.start_health_monitor()
+        assert _wait(lambda: not g.get_node("n1")["alive"], timeout=4.0)
+    finally:
+        fake.close()
+        g.stop()
+
+
+def test_indirect_probe_saves_node_gcs_cannot_reach(fast_detection,
+                                                    monkeypatch):
+    """Asymmetric GCS<->node partition: the direct probe fails but a peer
+    raylet's relayed probe succeeds — the healthy node is NOT killed.
+    The relay is driven through the node_probe pubsub + probe_report op,
+    exactly what a helper raylet does."""
+    g = GcsCore()
+    try:
+        # target registers with an address the GCS cannot reach (closed
+        # port); helper is a live peer that "can" reach it.
+        dead_port_sock = socket.create_server(("127.0.0.1", 0))
+        addr = ("127.0.0.1", dead_port_sock.getsockname()[1])
+        dead_port_sock.close()  # nothing listens: direct probe fails
+        g.register_node("target", addr, {"CPU": 1})
+        g.register_node("helper", ("127.0.0.1", 1), {"CPU": 1})
+
+        def on_push(event, data):
+            if event == "node_probe":
+                g.probe_report(data["token"], True)  # "I can see it"
+
+        g.subscribe(on_push, node_id="helper")
+
+        def helper_heartbeat():
+            while not g._stop.is_set():
+                g.heartbeat("helper", {"CPU": 1.0})
+                time.sleep(0.05)
+
+        threading.Thread(target=helper_heartbeat, daemon=True).start()
+        g.start_health_monitor()
+        assert _wait(lambda: g.health_stats()["false_suspects_total"] >= 1,
+                     timeout=4.0)
+        assert g.get_node("target")["alive"]
+        assert g.health_stats()["deaths_detected_total"] == 0
+    finally:
+        g.stop()
+
+
+def test_suspect_nodes_excluded_from_placement(fast_detection):
+    g = GcsCore()
+    g.register_node("a", None, {"CPU": 2.0})
+    g.register_node("b", None, {"CPU": 2.0})
+    with g._lock:
+        g._nodes["a"]["suspect"] = True
+    # placement and PG placement route around the suspect...
+    assert g.place_task({"CPU": 1.0}) == "b"
+    placed = g._place_bundles([{"CPU": 1.0}], "PACK")
+    assert set(placed.values()) == {"b"}
+    # ...but the node is still alive: no recovery was triggered
+    assert g.get_node("a")["alive"]
+    g.stop()
+
+
+def test_incarnation_fencing_rejects_stale_frames():
+    """Once a node is declared dead, frames stamped with its incarnation
+    are rejected across every node-attributed op — and a fresh
+    registration is assigned a STRICTLY greater incarnation."""
+    g = GcsCore()
+    snap = g.register_node("n1", None, {"CPU": 1})
+    inc = next(n["incarnation"] for n in snap if n["node_id"] == "n1")
+    assert g.heartbeat("n1", {}, incarnation=inc) is True
+    g._mark_dead("n1", "test kill")
+
+    assert g.heartbeat("n1", {}, incarnation=inc) == "fenced"
+    g.add_object_location("obj", "n1", 10, incarnation=inc)
+    assert g.get_object_locations("obj")["nodes"] == []
+    assert g.register_actor(b"a1", "n1", incarnation=inc) is False
+    g.add_task_events("n1", [{"task_id": "t1", "job_id": "j",
+                              "state": "FINISHED"}], incarnation=inc)
+    assert g.list_task_events() == []
+    fenced = g.health_stats()["fenced_frames_total"]
+    assert fenced >= 4
+
+    # unstamped legacy frames keep working (tests / pre-fencing callers)
+    assert g.heartbeat("n1", {}) is False  # plain "re-register" signal
+
+    snap = g.register_node("n1", None, {"CPU": 1})
+    new_inc = next(n["incarnation"] for n in snap if n["node_id"] == "n1")
+    assert new_inc > inc
+    assert g.heartbeat("n1", {}, incarnation=new_inc) is True
+    g.add_object_location("obj", "n1", 10, incarnation=new_inc)
+    assert g.get_object_locations("obj")["nodes"] == ["n1"]
+    # the OLD incarnation stays fenced even though the node is alive again
+    assert g.heartbeat("n1", {}, incarnation=inc) == "fenced"
+    g.stop()
+
+
+def test_incarnations_survive_gcs_restart(tmp_path):
+    """GCS restart x node death: incarnation counters are PERSISTED (a
+    resurrected partitioned node must not be handed its old generation
+    back), while suspect state — soft, like membership — resets clean."""
+    path = str(tmp_path / "gcs.snap")
+    g1 = GcsCore(persist_path=path)
+    snap = g1.register_node("n1", None, {"CPU": 1})
+    inc1 = next(n["incarnation"] for n in snap if n["node_id"] == "n1")
+    with g1._lock:
+        g1._nodes["n1"]["suspect"] = True  # in-flight suspicion
+    g1._write_snapshot()
+    g1.stop()
+
+    g2 = GcsCore(persist_path=path)
+    # membership is soft: the node is simply unknown after restart, and
+    # its old-incarnation frames are fenced (a node that died during the
+    # outage cannot resurrect directory entries)
+    assert g2.get_node("n1") is None
+    g2.add_object_location("obj", "n1", 10, incarnation=inc1)
+    assert g2.get_object_locations("obj")["nodes"] == []
+    assert g2.register_actor(b"ghost", "n1", incarnation=inc1) is False
+    # a stamped heartbeat from an unknown node is a plain re-register
+    # signal (False), not a fence: re-registration itself is the safe
+    # path back in — it bumps the incarnation
+    assert g2.heartbeat("n1", {}, incarnation=inc1) is False
+    assert g2.health_stats()["fenced_frames_total"] >= 2
+
+    # reconnecting raylet gets a STRICTLY greater incarnation than any
+    # pre-restart one, and comes back un-suspect
+    snap = g2.register_node("n1", None, {"CPU": 1})
+    info = next(n for n in snap if n["node_id"] == "n1")
+    assert info["incarnation"] > inc1
+    assert info["suspect"] is False
+    g2.stop()
+
+
+def test_drain_lifecycle_zero_detected_deaths():
+    """drain_node -> targeted node_drain push -> drain_complete retires
+    the node as an ANNOUNCED death: no time-to-detect sample, placement
+    excluded immediately, status queryable throughout."""
+    g = GcsCore()
+    g.register_node("n1", None, {"CPU": 2.0})
+    g.register_node("n2", None, {"CPU": 2.0})
+    pushes = []
+    g.subscribe(lambda ev, d: pushes.append((ev, d)), node_id="n1")
+
+    assert g.drain_status("n1") == {"state": "unknown"}
+    assert g.drain_node("n1", timeout_s=7.5) is True
+    # placement skips the draining node at once
+    assert g.place_task({"CPU": 1.0}) == "n2"
+    assert g.drain_status("n1")["state"] == "draining"
+    drain_pushes = [d for ev, d in pushes if ev == "node_drain"]
+    assert drain_pushes and drain_pushes[0]["timeout_s"] == 7.5
+
+    g.drain_complete("n1", {"objects_migrated": 3})
+    st = g.drain_status("n1")
+    assert st["state"] == "drained"
+    assert st["stats"] == {"objects_migrated": 3}
+    info = g.get_node("n1")
+    assert not info["alive"]
+    stats = g.health_stats()
+    assert stats["deaths_detected_total"] == 0  # announced, not detected
+    assert stats["time_to_detect_s"] == []
+    # draining an unknown/dead node is refused
+    assert g.drain_node("n1") is False
+    assert g.drain_node("ghost") is False
+    g.stop()
+
+
+def test_network_chaos_partition_and_heal():
+    """Asymmetric per-peer partitions: deterministic drops in the chosen
+    direction only, heal() restores, probabilistic replay unaffected."""
+    from ray_tpu.util.chaos import NetworkChaos
+
+    n = NetworkChaos(channels=["data"])  # no probabilistic faults
+    assert n.decide("data", peer="B") is None
+
+    n.partition("B", direction="out")
+    assert n.decide("data", peer="B", direction="out") == "drop"
+    assert n.decide("data", peer="B", direction="in") is None  # asymmetric
+    assert n.decide("data", peer="C", direction="out") is None  # pair only
+    assert n.faults["partition"] == 1
+
+    n.partition("B", direction="both")
+    assert n.decide("peer", peer="B", direction="in") == "drop"
+    # partitions apply to EVERY channel by default (unlike the
+    # probabilistic faults, which honor the channels gate)
+    assert n.decide("gcs", peer="B", direction="out") == "drop"
+
+    n.heal("B")
+    assert n.decide("data", peer="B", direction="out") is None
+
+    # wildcard partition + full heal
+    n.partition("*", direction="in")
+    assert n.decide("data", peer="anyone", direction="in") == "drop"
+    assert n.decide("data", peer="anyone", direction="out") is None
+    n.heal()
+    assert n.decide("data", peer="anyone", direction="in") is None
+
+    # channel-narrowed partition
+    n.partition("B", direction="both", channels=["peer"])
+    assert n.decide("peer", peer="B") == "drop"
+    assert n.decide("data", peer="B") is None
+    n.heal()
+
+    # determinism: a partition window does not consume RNG draws, so the
+    # probabilistic sequence replays identically around it
+    a = NetworkChaos(drop_p=0.3, seed=9, channels=["data"])
+    b = NetworkChaos(drop_p=0.3, seed=9, channels=["data"])
+    seq_a = [a.decide("data") for _ in range(50)]
+    b.partition("X")
+    for _ in range(25):
+        b.decide("data", peer="X")  # all partition drops, no RNG draws
+    b.heal("X")
+    seq_b = [b.decide("data") for _ in range(50)]
+    assert seq_a == seq_b
+
+
+def test_network_chaos_partition_file(tmp_path):
+    """Control-file steering: a test driver partitions and heals a live
+    process by rewriting the JSON file (re-read at most every 50ms)."""
+    import json
+
+    from ray_tpu.util.chaos import NetworkChaos
+
+    ctl = tmp_path / "partition.json"
+    n = NetworkChaos(partition_file=str(ctl))
+    assert n.decide("data", peer="B") is None  # no file yet
+
+    ctl.write_text(json.dumps({"partitions": {"B": "out"}}))
+    time.sleep(0.06)  # past the refresh interval
+    assert n.decide("data", peer="B", direction="out") == "drop"
+    assert n.decide("data", peer="B", direction="in") is None
+
+    ctl.write_text(json.dumps({"partitions": {}}))
+    time.sleep(0.06)
+    assert n.decide("data", peer="B", direction="out") is None
