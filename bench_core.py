@@ -289,6 +289,9 @@ def main():
 
     ray_tpu.shutdown()
 
+    # ---- request-flow tracing overhead (fresh traced runtime) ----
+    bench_trace(results, record, scale)
+
     # ---- cross-node data plane (two-node same-host harness) ----
     bench_remote(results, record, scale)
 
@@ -307,6 +310,66 @@ def main():
                            "BENCH_CORE.json"), "w") as f:
         json.dump(results, f, indent=1)
     return 0
+
+
+def bench_trace(results, record, scale):
+    """Request-flow tracing tax on tasks_async, task_events_overhead-style:
+    a fresh runtime with tracing armed in every process, then interleaved
+    best-of-2 rates with the pipeline OFF (RAY_TPU_TRACE=0 kill switch),
+    head-sampled at 1% (the production setting), and at 100%.  Only the
+    driver's env toggles — sampling is decided at the trace root and rides
+    the span context, so workers follow without restarts."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    os.environ["RAY_TPU_TRACE"] = "1"
+    tracing.enable_tracing()
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    n = int(10000 * scale)
+
+    def tasks_async():
+        ray_tpu.get([nop.remote() for _ in range(n)])
+
+    modes = [
+        ("off", {"RAY_TPU_TRACE": "0"}),
+        ("sampled_1pct", {"RAY_TPU_TRACE": "1",
+                          "RAY_TPU_TRACE_SAMPLE": "0.01"}),
+        ("sampled_all", {"RAY_TPU_TRACE": "1",
+                         "RAY_TPU_TRACE_SAMPLE": "1.0"}),
+    ]
+    rates = {name: 0.0 for name, _ in modes}
+    try:
+        # best-of-3 with the mode order REVERSED on odd rounds: a host
+        # that slows (or warms) monotonically through the run biases
+        # every fixed ordering — the palindrome cancels linear drift
+        for rnd in range(3):
+            for name, env in (modes if rnd % 2 == 0 else modes[::-1]):
+                os.environ.update(env)
+                rates[name] = max(rates[name], timed(n, tasks_async))
+    finally:
+        os.environ["RAY_TPU_TRACE"] = "0"
+        os.environ.pop("RAY_TPU_TRACE_SAMPLE", None)
+    ray_tpu.shutdown()
+    record("tasks_async_trace_off_per_s", rates["off"])
+    record("tasks_async_traced_1pct_per_s", rates["sampled_1pct"])
+    record("tasks_async_traced_all_per_s", rates["sampled_all"])
+    for name, key, setting in (
+            ("trace_overhead", "sampled_1pct", "RAY_TPU_TRACE_SAMPLE=0.01"),
+            ("trace_overhead_full", "sampled_all",
+             "RAY_TPU_TRACE_SAMPLE=1.0")):
+        results[name] = {
+            "value": round(
+                max(0.0, 1.0 - rates[key] / max(rates["off"], 1e-9)), 4),
+            "unit": (f"fraction of tasks_async throughput lost with "
+                     f"request-flow tracing at {setting} vs disabled"),
+        }
+        print(json.dumps({"metric": name, **results[name]}), flush=True)
 
 
 def bench_remote(results, record, scale):

@@ -258,8 +258,16 @@ def timeline(filename: Optional[str] = None):
 
     w = global_worker()
     events = [] if w.mode == "local" else raw_task_events()
-    spans = (_tracing.read_spans(name_prefix="task.submit")
-             if _tracing.tracing_enabled() else None)
+    spans = None
+    if _tracing.tracing_enabled():
+        # flow-arrow feed: per-process JSONL files when a trace dir is
+        # configured, else the cluster-wide GCS trace table
+        spans = _tracing.read_spans(name_prefix="task.submit")
+        if not spans and w.mode != "local":
+            from ray_tpu.util.state import list_trace_spans
+
+            spans = [sp for sp in list_trace_spans()
+                     if str(sp.get("name", "")).startswith("task.submit")]
     trace = build_timeline(events, spans=spans)
     if filename:
         with open(filename, "w") as f:

@@ -67,6 +67,16 @@ class ClientWorker(Worker):
                 self.store = ShmObjectStore(store_path)
             except OSError:
                 self.store = None  # different host: no shm access
+        from ray_tpu.util import tracing
+
+        tracing.maybe_enable_from_env()
+        if tracing.tracing_enabled():
+            # ship this driver's spans (task.submit / task.get / serve
+            # hops) to the raylet like a worker does — the raylet batches
+            # them into the GCS trace table
+            tracing.set_flush_target(
+                lambda spans, dropped: self._send(
+                    {"t": "spans", "spans": spans, "dropped": dropped}))
 
     # Worker.get/put/wait/submit use _send/_request like worker mode does.
 

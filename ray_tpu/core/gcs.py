@@ -114,6 +114,12 @@ class GcsCore:
         # (config.task_events_max_per_job), soft state — never persisted.
         self._task_events: Dict[str, dict] = {}  # guard: _lock
         self._task_events_dropped = 0  # guard: _lock
+        # Trace-span table (request-flow tracing): job_id -> deque of span
+        # records, bounded per job like the task-event table; producer-side
+        # drops (raylet export buffers) and GCS-side evictions both count.
+        # Soft state — never persisted.
+        self._trace_spans: Dict[str, deque] = {}  # guard: _lock
+        self._trace_dropped = 0  # guard: _lock
         # oid(hex) -> {nodes: set[node_id], size, inline}
         self._objects: Dict[str, dict] = {}  # guard: _lock
         # oid(hex) -> set of watcher node_ids (want a push when located)
@@ -823,8 +829,34 @@ class GcsCore:
                     "detected node failures",
                     boundaries=(0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0),
                     tag_keys=("node",)).set_default_tags(tags),
+                "false_suspects": _metrics.internal_metric(
+                    _metrics.Counter,
+                    "ray_tpu_internal_false_suspects_total",
+                    "SUSPECT nodes that recovered (probe or heartbeat "
+                    "cleared the suspicion)",
+                    tag_keys=("node",)).set_default_tags(tags),
+                "deaths": _metrics.internal_metric(
+                    _metrics.Counter,
+                    "ray_tpu_internal_node_deaths_detected_total",
+                    "Node deaths INFERRED from silence/probes (announced "
+                    "drain deaths excluded)",
+                    tag_keys=("node",)).set_default_tags(tags),
+                "probe_deaths": _metrics.internal_metric(
+                    _metrics.Counter,
+                    "ray_tpu_internal_probe_confirmed_deaths_total",
+                    "Node deaths confirmed sub-second by a failed "
+                    "direct+indirect probe pair",
+                    tag_keys=("node",)).set_default_tags(tags),
+                "drains": _metrics.internal_metric(
+                    _metrics.Gauge, "ray_tpu_internal_node_drains",
+                    "Nodes with a recorded drain lifecycle (draining or "
+                    "drained)", tag_keys=("node",)).set_default_tags(tags),
             }
-            self._gm_fenced_last = 0
+            # delta-sync baselines: the _m_* counters are bumped inline
+            # under _lock; the flusher ships increments into the Counter
+            # instruments so restarts/re-inits never double-count
+            self._gm_last = {"fenced": 0, "false_suspects": 0, "deaths": 0,
+                             "probe_deaths": 0}
         except Exception:  # noqa: BLE001 — stats-only fallback
             self._gm = None
 
@@ -834,11 +866,17 @@ class GcsCore:
         import json as _json
 
         with self._lock:
-            fenced = self._m_fenced
-        delta = fenced - self._gm_fenced_last
-        if delta > 0:
-            self._gm["fenced"].inc(delta)
-        self._gm_fenced_last = fenced
+            current = {"fenced": self._m_fenced,
+                       "false_suspects": self._m_false_suspects,
+                       "deaths": self._m_deaths,
+                       "probe_deaths": self._m_probe_deaths}
+            drains = len(self._drains)
+        for key, value in current.items():
+            delta = value - self._gm_last[key]
+            if delta > 0:
+                self._gm[key].inc(delta)
+            self._gm_last[key] = value
+        self._gm["drains"].set(drains)
         items = []
         for m in self._gm.values():
             try:
@@ -1379,6 +1417,64 @@ class GcsCore:
         return {"by_state": by_state, "num_tasks": num_tasks,
                 "num_dropped": dropped, "nodes": sorted(nodes)}
 
+    # -------------------------------------------------------- trace table
+
+    def add_trace_spans(self, node_id: str, spans: List[dict],
+                        dropped: int = 0,
+                        incarnation: Optional[int] = None):
+        """Batch append from one process's span export buffer.  ``dropped``
+        counts spans that producer shed to backpressure since its last
+        flush.  Like task events, batches from a fenced node are rejected
+        whole (a resurrected node must not rewrite request history)."""
+        cap = max(1, config.trace_table_max)
+        with self._lock:
+            if not self._fence_ok(node_id, incarnation):
+                return
+            self._trace_dropped += dropped
+            last_job, log = None, None
+            for sp in spans:
+                job = sp.get("job") or "driver"
+                if job != last_job:  # batches are almost always one job
+                    log = self._trace_spans.get(job)
+                    if log is None:
+                        log = self._trace_spans[job] = deque(maxlen=cap)
+                    last_job = job
+                if len(log) == cap:
+                    self._trace_dropped += 1  # eviction, counted
+                log.append(sp)
+
+    def get_trace(self, trace_id: str) -> List[dict]:
+        """Every retained span of one trace, cluster-wide (the flat
+        record list — ``util.trace_analysis`` turns it into a tree /
+        waterfall)."""
+        with self._lock:
+            return [sp for log in self._trace_spans.values()
+                    for sp in log if sp.get("trace_id") == trace_id]
+
+    def list_trace_spans(self, job_id: Optional[str] = None,
+                         limit: int = 10000) -> List[dict]:
+        """The most recent retained spans (newest last) — feed for the
+        aggregate "where do the microseconds go" breakdown."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            if job_id is not None:
+                logs = [self._trace_spans.get(job_id) or ()]
+            else:
+                logs = list(self._trace_spans.values())
+            rows = [sp for log in logs for sp in log]
+        rows.sort(key=lambda sp: sp.get("start_us", 0))
+        return rows[-limit:]
+
+    def trace_table_stats(self) -> dict:
+        with self._lock:
+            num = sum(len(v) for v in self._trace_spans.values())
+            traces = {sp.get("trace_id")
+                      for log in self._trace_spans.values() for sp in log}
+            return {"num_spans": num, "num_traces": len(traces),
+                    "num_dropped": self._trace_dropped,
+                    "jobs": sorted(self._trace_spans)}
+
     # ----------------------------------------------------------- snapshot
 
     def state_snapshot(self) -> dict:
@@ -1418,6 +1514,7 @@ _OPS = {
     "create_pg", "pg_fragment_ready", "remove_cluster_pg", "pg_info",
     "add_task_events", "list_task_events", "task_events_raw",
     "summarize_task_events",
+    "add_trace_spans", "get_trace", "list_trace_spans", "trace_table_stats",
     "state_snapshot",
 }
 
@@ -1583,6 +1680,18 @@ class GcsClient:
     def _call(self, op: str, *args, **kw):
         if self._closed:
             raise ConnectionError("GCS connection lost")
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.tracing_enabled() \
+                and _tracing.current_trace_ctx() is not None:
+            # a traced request is on this thread's stack: span the RPC
+            # (GCS hops show up in the request waterfall, not just the
+            # aggregate latency histogram)
+            with _tracing.maybe_span(f"gcs.rpc {op}"):
+                return self._call_inner(op, args, kw)
+        return self._call_inner(op, args, kw)
+
+    def _call_inner(self, op: str, args, kw):
         with self._rid_lock:
             self._rid += 1
             rid = self._rid

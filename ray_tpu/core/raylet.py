@@ -59,6 +59,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.util import chaos as _chaos
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.locks import make_lock
 from ray_tpu.util.retry import BackoffPolicy
 
@@ -530,6 +531,28 @@ class Raylet:
         self._flag_task_events = config._flags["task_events"]
         self._flag_event_cap = config._flags["task_event_export_buffer"]
         self._flag_state_cap = config._flags["task_event_buffer_size"]
+        # Trace-span export (request-flow tracing): spans from this
+        # process (raylet hop spans + driver spans — they share a process
+        # in single-node mode) and from workers ("spans" control frames)
+        # buffer here and batch-flush to the GCS trace table on the same
+        # drain/timer cadence as task events.
+        _tracing.maybe_enable_from_env()
+        self._trace_buf: deque = deque()
+        self._trace_export_dropped = 0        # since last flush (shipped)
+        self._trace_dropped_total = 0         # lifetime (metrics)
+        self._trace_timer_armed = False
+        if _tracing.tracing_enabled():
+            # heartbeat from the start: driver-side spans (same process,
+            # different thread) reach the GCS table without waiting for a
+            # raylet-side emit to arm the timer
+            self._arm_trace_flush()
+        # recovery-span bookkeeping: creating task_id -> (t0, parent_ctx,
+        # oid_hex) captured when a reconstruction starts, emitted when it
+        # concludes
+        self._recon_trace: Dict[TaskID, tuple] = {}
+        # traced arg pulls: oid -> (t0, parent_ctx); span emitted when the
+        # pull seals/fails (one child span per data-channel pull)
+        self._pull_trace: Dict[ObjectID, tuple] = {}
         # Internal runtime metrics (ray_tpu_internal_*): plain event-thread
         # counters sampled into util.metrics primitives at flush time.
         self._im: Optional[Dict[str, object]] = None
@@ -753,6 +776,7 @@ class Raylet:
                         self._safe(lambda c=conn: self._on_worker_death(c))
         # cleanup
         self._safe(self.flush_task_events)  # don't lose the last window
+        self._safe(self.flush_trace_spans)
         for conn in list(self._workers.values()):
             try:
                 conn.send({"t": "shutdown"})
@@ -1277,6 +1301,9 @@ class Raylet:
             self._on_actor_checkpoint(conn, msg)
         elif t == "ref_events":
             self.apply_ref_events(msg["events"], conn)
+        elif t == "spans":
+            # worker span batch (request-flow tracing) -> GCS trace table
+            self._trace_ingest(msg["spans"], msg.get("dropped", 0))
 
     def _on_task_done(self, conn: _WorkerConn, msg: dict):
         tid = msg.get("task_id")
@@ -1285,6 +1312,7 @@ class Raylet:
             spec = conn.current_task
         if spec is None:
             return
+        trace_t0 = time.time() if self._spec_traced(spec) else 0.0
         # Clear ALL bookkeeping for this attempt up front — a retry
         # re-enters via _enqueue_ready below and must register fresh state,
         # not have its new entries popped by this (finished) attempt.
@@ -1323,8 +1351,14 @@ class Raylet:
                                           contains=contains.get(hex_id))
                     # eager availability: push a secondary copy of a big
                     # (or explicitly flagged) result while it is hot
-                    self._maybe_replicate(oid, force=spec.replicate)
+                    self._maybe_replicate(oid, force=spec.replicate,
+                                          trace_ctx=spec.trace_ctx)
                 self._record_event(spec, "FINISHED")
+            if trace_t0:
+                # result hop: done-frame processing + sealing the return
+                # objects (waiter wakeups included)
+                self._trace_hop(spec, "raylet.result", trace_t0,
+                                status="ERROR" if task_failed else "OK")
         # worker back to pool / actor next call
         if spec.kind == ACTOR_CREATION_TASK:
             if task_failed:
@@ -2039,6 +2073,7 @@ class Raylet:
                 # the holder's STORE can't serve it)
                 store_deps[oid.hex()] = (list(st.locations), st.size,
                                          st.remote_inline)
+        fwd_t0 = time.time() if self._spec_traced(spec) else 0.0
         spec._acquired_pool = None
         spec._spill_count = getattr(spec, "_spill_count", 0) + 1
         self._forwarded[spec.task_id] = (spec, node_id)
@@ -2059,6 +2094,10 @@ class Raylet:
                     actor.node_id = None  # roll back the tentative placement
             self._drop_peer(peer)
             return False
+        if fwd_t0:
+            # forward hop: dep snapshotting + the xtask frame hand-off;
+            # the receiving raylet opens its own inbox span on receipt
+            self._trace_hop(spec, "raylet.forward", fwd_t0, to_node=node_id)
         return True
 
     def _handle_xtask(self, peer: _PeerConn, msg: dict):
@@ -2113,6 +2152,8 @@ class Raylet:
     def _handle_xdone(self, msg: dict):
         entry = self._forwarded.pop(msg["task_id"], None)
         spec = entry[0] if entry else None
+        xdone_t0 = (time.time()
+                    if spec is not None and self._spec_traced(spec) else 0.0)
         failed = False
         contains = msg.get("contains", {})
         for h, r in msg["results"].items():
@@ -2136,6 +2177,11 @@ class Raylet:
             return
         self._record_event(spec, "FAILED" if failed else "FINISHED",
                            remote=True)
+        if xdone_t0:
+            # owner-side result registration for a forwarded task (the
+            # executing node's raylet.result covered the seal over there)
+            self._trace_hop(spec, "raylet.xdone", xdone_t0,
+                            status="ERROR" if failed else "OK")
         if spec.kind == ACTOR_CREATION_TASK:
             actor = self._actors.get(spec.actor_id)
             if actor is not None:
@@ -2328,13 +2374,17 @@ class Raylet:
         self._pull_sender_submit(stream)
 
     def _maybe_pull(self, oid: ObjectID, force_lookup: bool = False,
-                    priority: int = 1):
+                    priority: int = 1, trace_ctx: Optional[dict] = None):
         """Start fetching a non-local object.  Location from local metadata,
         else the GCS directory (registering a watch when unknown).
 
         ``priority``: 0 = task-argument pull (admitted ahead of
         speculative/get prefetch, which is 1) — only meaningful on the
         pull-manager path.
+
+        ``trace_ctx``: span context of the request whose arguments need
+        this object — the pull becomes a child span in its waterfall
+        (one per data-channel pull, emitted when the pull concludes).
 
         Store objects normally move over the zero-copy data plane
         (pull_manager striping across every known holder); inline objects
@@ -2345,6 +2395,12 @@ class Raylet:
         st = self._obj(oid)
         if st.status not in ("pending", "remote") or oid in self._pulls:
             return
+        if (trace_ctx is not None and trace_ctx.get("sampled", True)
+                and _tracing.tracing_enabled()
+                and oid not in self._pull_trace):
+            if len(self._pull_trace) > 2048:  # never-concluding watches
+                self._pull_trace.pop(next(iter(self._pull_trace)))
+            self._pull_trace[oid] = (time.time(), trace_ctx)
         if (self._pull_manager is not None and not force_lookup
                 and self._pull_manager.active(oid)):
             # already pulling: request() below would only dedup — but let a
@@ -2442,6 +2498,7 @@ class Raylet:
         # complete
         self._pull_by_rid.pop(msg["rid"], None)
         del self._pulls[oid]
+        self._finish_pull_trace(oid, "control_plane")
         st = self._obj(oid)
         if pull["kind"] == "inline":
             self._object_inline(oid, bytes(pull["buf"]))
@@ -2476,6 +2533,8 @@ class Raylet:
         oid = self._pull_by_rid.pop(msg["rid"], None)
         if oid is None:
             return
+        self._finish_pull_trace(oid, "control_plane", status="ERROR",
+                                error=str(msg.get("error", "pull failed")))
         pull = self._pulls.pop(oid, None)
         st = self._objects.get(oid)
         if st is not None and pull is not None:
@@ -2490,10 +2549,27 @@ class Raylet:
                     st.status = "pending"
                     self._recover_or_retry(oid, st)
 
+    def _finish_pull_trace(self, oid: ObjectID, path: str,
+                           status: str = "OK", error: Optional[str] = None):
+        """Close out a traced argument pull: one ``pull.fetch`` child span
+        under the requesting task, with the transfer path (data_channel /
+        control fallback) and byte count from the directory metadata."""
+        rec = self._pull_trace.pop(oid, None)
+        if rec is None:
+            return
+        t0, ctx = rec
+        st = self._objects.get(oid)
+        _tracing.hop(f"pull.fetch {oid.hex()[:8]}", ctx, t0, time.time(),
+                     status=status, error=error, proc="raylet",
+                     oid=oid.hex(), path=path,
+                     bytes=(st.size if st is not None else 0) or 0)
+        self._arm_trace_flush()
+
     # ---- data-plane pull callbacks (posted by the pull manager) ----
 
     def _on_pull_done(self, oid: ObjectID):
         """A data-plane pull sealed the object in the local store."""
+        self._finish_pull_trace(oid, "data_channel")
         st = self._obj(oid)
         if st.status in ("pending", "remote"):
             self._object_in_store(oid)
@@ -2504,6 +2580,8 @@ class Raylet:
         the retry may pick fresh holders, fall back to the control-plane
         path when no data channel can be dialed — or, when no holder
         exists anywhere anymore, reconstruct from lineage."""
+        self._finish_pull_trace(oid, "data_channel", status="ERROR",
+                                error=f"all sources failed: {bad_nodes}")
         st = self._objects.get(oid)
         if st is None or st.status not in ("pending", "remote"):
             return
@@ -2576,7 +2654,8 @@ class Raylet:
             st = self._objects.get(oid)
             status = st.status if st is not None else "pending"
             if status not in ("inline", "store", "error"):
-                self._maybe_pull(oid, priority=0)  # task arg: high priority
+                self._maybe_pull(oid, priority=0,  # task arg: high priority
+                                 trace_ctx=spec.trace_ctx)
                 pending = True
         return pending
 
@@ -2874,6 +2953,11 @@ class Raylet:
         self._m_recon_attempts += 1
         if self._im is not None:
             self._im["recon_depth"].observe(_depth)
+        if _tracing.tracing_enabled():
+            # recovery spans parent under the request that produced the
+            # lost object (its ctx rides the retained creating spec)
+            self._recon_trace[spec.task_id] = (time.time(), spec.trace_ctx,
+                                               oid.hex())
         self._reconstructing.add(spec.task_id)
         self.async_get(spec.return_ids(),
                        lambda results, s=spec: self._on_recon_done(s, results))
@@ -2885,7 +2969,17 @@ class Raylet:
         """All returns of a reconstruction attempt resolved (sealed or
         errored) — close out the attempt and count the outcome."""
         self._reconstructing.discard(spec.task_id)
-        if any(r[0] == "error" for r in results.values()):
+        failed = any(r[0] == "error" for r in results.values())
+        rec = self._recon_trace.pop(spec.task_id, None)
+        if rec is not None:
+            t0, ctx, oid_hex = rec
+            _tracing.hop(f"recovery.reconstruct {spec.name}", ctx, t0,
+                         time.time(),
+                         status="ERROR" if failed else "OK",
+                         proc="raylet", object_id=oid_hex,
+                         task_id=spec.task_id.hex())
+            self._arm_trace_flush()
+        if failed:
             self._m_recon_failures += 1
         else:
             self._m_recon_successes += 1
@@ -2898,10 +2992,13 @@ class Raylet:
     # asks the target to PULL, so striping/admission/failover all reuse
     # the pull manager.)
 
-    def _maybe_replicate(self, oid: ObjectID, force: bool = False):
+    def _maybe_replicate(self, oid: ObjectID, force: bool = False,
+                         trace_ctx: Optional[dict] = None):
         """Push secondary copies of a locally sealed store object when it
         crosses the auto-threshold (RAY_TPU_REPLICATION_MIN_BYTES) or was
-        explicitly flagged (``force``: _replicate option / checkpoint)."""
+        explicitly flagged (``force``: _replicate option / checkpoint).
+        ``trace_ctx``: the producing request's span context — the
+        replication push shows up in its waterfall."""
         if not self.cluster_mode:
             return
         st = self._objects.get(oid)
@@ -2910,8 +3007,14 @@ class Raylet:
         thresh = config.replication_min_bytes
         if not force and (thresh <= 0 or (st.size or 0) < thresh):
             return
-        self._replicate_object(oid, st,
-                               max(1, config.replication_factor) - 1)
+        t0 = time.time() if _tracing.tracing_enabled() else 0.0
+        sent = self._replicate_object(oid, st,
+                                      max(1, config.replication_factor) - 1)
+        if t0 and sent:
+            _tracing.hop(f"recovery.replicate {oid.hex()[:8]}", trace_ctx,
+                         t0, time.time(), proc="raylet", oid=oid.hex(),
+                         targets=sent, bytes=st.size or 0)
+            self._arm_trace_flush()
 
     def _replicate_object(self, oid: ObjectID, st: "_ObjectState",
                           count: int, exclude=(), attempt: int = 0) -> int:
@@ -3398,6 +3501,12 @@ class Raylet:
         (which stays the owner of actors and handles restarts); skip the
         owner-side registrations.
         """
+        if spec.trace_ctx is not None:
+            # inbox-receipt timestamp: the first lifecycle transition
+            # closes the raylet.inbox hop span.  A forwarded spec re-opens
+            # it here (fresh node, fresh inbox interval).
+            spec._tr_in = time.time()
+            spec._tr_prev = None
         # Lineage for eviction recovery: NORMAL tasks only (actor results
         # aren't replayable) and bounded — beyond the cap new objects lose
         # reconstructability instead of the raylet growing without limit
@@ -3459,7 +3568,8 @@ class Raylet:
                 # A dep produced on another node resolves via the GCS
                 # directory watch the pull registers.
                 for oid in missing:
-                    self._maybe_pull(oid, priority=0)  # task args
+                    self._maybe_pull(oid, priority=0,  # task args
+                                     trace_ctx=spec.trace_ctx)
         else:
             self._enqueue_ready(spec)
         self._schedule()
@@ -4018,17 +4128,28 @@ class Raylet:
                 "fn_blob": fn_blob}
 
     def _dispatch(self, spec: TaskSpec, conn: _WorkerConn):
+        t0 = time.time() if self._spec_traced(spec) else 0.0
         conn.send(self._dispatch_msg(spec, conn))
+        if t0:
+            # dispatch hop: message construction (arg inlining, function
+            # blob resolution) + the socket hand-off to the worker
+            self._trace_hop(spec, "raylet.dispatch", t0, pid=conn.pid)
 
     def _dispatch_many(self, specs: List[TaskSpec], conn: _WorkerConn):
         """Dispatch a sequential batch in one coalesced frame; the worker
         sees ordinary per-task messages (recv_msg splits the frames) and
         runs them in order.  current_task ends as specs[0] — the one the
         worker starts executing first."""
+        t0 = time.time() if any(map(self._spec_traced, specs)) else 0.0
         msgs = [self._dispatch_msg(s, conn, running=(i == 0))
                 for i, s in enumerate(specs)]
         conn.current_task = specs[0]
         conn.send_many(msgs)
+        if t0:
+            for s in specs:
+                if self._spec_traced(s):
+                    self._trace_hop(s, "raylet.dispatch", t0, pid=conn.pid,
+                                    batch=len(specs))
 
     def _pump_actor(self, actor: _ActorState):
         if actor.node_id is not None and actor.node_id != self.node_id:
@@ -4067,6 +4188,7 @@ class Raylet:
         # relative order in the deferred queue).
         deferred_groups: deque = deque()
         out_msgs = []
+        traced_dispatches: list = []  # (spec, t0, pid) — hop spans
         while (actor.state == "alive" and actor.conn is not None
                and actor.queue and len(actor.inflight) < actor.admit_limit()):
             spec = actor.queue.popleft()
@@ -4101,12 +4223,16 @@ class Raylet:
                 st = self._objects.get(oid)
                 if st is not None and st.status == "inline":
                     arg_values[oid.hex()] = st.value
+            if self._spec_traced(spec):
+                traced_dispatches.append((spec, time.time(), conn.pid))
             self._record_event(spec, "RUNNING", pid=conn.pid)
             out_msgs.append({"t": "task", "spec": spec,
                              "arg_values": arg_values, "fn_blob": None})
         if out_msgs and actor.conn is not None:
             # one coalesced frame for the whole pump (one sendall)
             actor.conn.send_many(out_msgs)
+            for spec, t0, pid in traced_dispatches:
+                self._trace_hop(spec, "raylet.dispatch", t0, pid=pid)
         # put group-saturated specs back at the FRONT, preserving order
         while deferred_groups:
             actor.queue.appendleft(deferred_groups.pop())
@@ -4444,6 +4570,17 @@ class Raylet:
                 kw = {k: msg[k] for k in ("job_id", "state", "limit")
                       if k in msg}
                 reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
+            elif op == "flush_trace_spans":
+                self.flush_trace_spans()
+                reply()
+            elif op in ("get_trace", "list_trace_spans",
+                        "trace_table_stats"):
+                # Cluster-wide trace reads proxied to the GCS trace table;
+                # flush so this node's freshest spans count.
+                self.flush_trace_spans()
+                kw = {k: msg[k] for k in ("trace_id", "job_id", "limit")
+                      if k in msg}
+                reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
             elif op == "kill_actor":
                 self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
                 reply()
@@ -4708,6 +4845,125 @@ class Raylet:
         except Exception:  # noqa: BLE001
             return type(err).__name__
 
+    # ---- request-flow tracing (hop spans + span export pipeline) ----
+
+    # Lifecycle interval -> hop span emitted when the NEXT transition
+    # closes it.  RUNNING is deliberately absent: the executing worker's
+    # task.run span (with get_args/exec/result_push children) owns that
+    # interval — a raylet-side copy would double-attribute it.
+    _TRACE_PHASE = {
+        "PENDING_ARGS": "raylet.pending_args",
+        "QUEUED": "raylet.queue",
+        "FORWARDED": "raylet.await_remote",
+        "SPILLED": "raylet.await_remote",
+        "RECONSTRUCTING": "raylet.reconstructing",
+    }
+
+    @staticmethod
+    def _spec_traced(spec: TaskSpec) -> bool:
+        """Does this spec belong to a SAMPLED trace?  (The ctx rides the
+        spec across processes; unsampled requests carry the bit so error
+        paths can still export with real ids.)"""
+        ctx = spec.trace_ctx
+        return ctx is not None and ctx.get("sampled", True) \
+            and _tracing.tracing_enabled()
+
+    def _trace_hop(self, spec: TaskSpec, name: str, t0: float,
+                   t1: Optional[float] = None, status: str = "OK",
+                   error: Optional[str] = None, **attrs):
+        """Emit one measured hop span under the request's submit span."""
+        ctx = spec.trace_ctx
+        _tracing.emit_span(
+            f"{name} {spec.name}", ctx["trace_id"], ctx.get("span_id"),
+            t0, time.time() if t1 is None else t1, status=status,
+            error=error, proc="raylet", task_id=spec.task_id.hex(), **attrs)
+        self._arm_trace_flush()
+
+    def _arm_trace_flush(self):
+        """Schedule a span flush for locally-emitted spans (they land in
+        the process buffer without a control frame to piggyback on)."""
+        if not self._trace_timer_armed:
+            self._trace_timer_armed = True
+            self.add_timer(config.trace_flush_interval_s,
+                           self._trace_flush_tick)
+
+    def _trace_transition(self, spec: TaskSpec, state: str, t: float,
+                          error: Optional[str] = None):
+        """Lifecycle transition -> close the previous phase's interval as
+        a hop span.  The first transition also closes the inbox interval
+        (raylet receipt -> first classification) opened by submit_task."""
+        prev = getattr(spec, "_tr_prev", None)
+        if prev is None:
+            t_in = getattr(spec, "_tr_in", None)
+            if t_in is not None:
+                self._trace_hop(spec, "raylet.inbox", t_in, t)
+        else:
+            name = self._TRACE_PHASE.get(prev[0])
+            if name is not None:
+                failed = state == "FAILED"
+                self._trace_hop(spec, name, prev[1], t,
+                                status="ERROR" if failed else "OK",
+                                error=error if failed else None)
+        spec._tr_prev = (state, t)
+
+    def _trace_ingest(self, spans: List[dict], dropped: int = 0):
+        """Append a span batch (worker control frames / the local
+        process buffer) to the bounded export buffer and arm the flush."""
+        buf = self._trace_buf
+        cap = config.trace_buffer_size
+        self._trace_export_dropped += dropped
+        self._trace_dropped_total += dropped
+        for sp in spans:
+            buf.append(sp)
+            if len(buf) > cap:
+                buf.popleft()
+                self._trace_export_dropped += 1
+                self._trace_dropped_total += 1
+        if buf:
+            self._arm_trace_flush()
+
+    def flush_trace_spans(self):
+        """Drain this process's span buffer plus everything workers have
+        shipped, and post the batch to the GCS trace table."""
+        local, dropped = _tracing.drain_pending()
+        if local or dropped:
+            self._trace_ingest(local, dropped)
+        if not self._trace_buf and not self._trace_export_dropped:
+            return
+        spans = list(self._trace_buf)
+        self._trace_buf.clear()
+        dropped = self._trace_export_dropped
+        self._trace_export_dropped = 0
+        try:
+            if isinstance(self.gcs, GcsClient):
+                self.gcs.post("add_trace_spans", self.node_id, spans,
+                              dropped, incarnation=self.incarnation)
+            else:
+                self.gcs.add_trace_spans(self.node_id, spans, dropped,
+                                         incarnation=self.incarnation)
+        except (ConnectionError, TimeoutError, OSError):
+            # GCS unreachable: the batch is gone — count it (locally for
+            # the metric, and toward the next successful flush so
+            # trace_table_stats sees the hole) instead of silently
+            # reporting zero drops across an outage.
+            self._trace_dropped_total += len(spans)
+            self._trace_export_dropped += dropped + len(spans)
+
+    def _trace_flush_tick(self):
+        # One-shot timer, armed lazily by the first ingest: an untraced
+        # raylet pays nothing for the span pipeline.
+        self._trace_timer_armed = False
+        self.flush_trace_spans()
+        # The driver emits spans without notifying the raylet (same
+        # process, different thread): while tracing is live, keep a slow
+        # heartbeat so a trailing driver-only span (a late task.get, a
+        # serve.route) can't strand in the process buffer forever.
+        if not self._shutdown and (_tracing.tracing_enabled()
+                                   or _tracing.has_pending()):
+            self._trace_timer_armed = True
+            self.add_timer(config.trace_flush_interval_s,
+                           self._trace_flush_tick)
+
     def _record_event(self, spec: TaskSpec, state: str, **extra):
         attempt = spec.max_retries - spec.retries_left
         ev = {
@@ -4721,6 +4977,18 @@ class Raylet:
             "attempt": attempt if attempt > 0 else 0,
             **extra,
         }
+        if spec.trace_ctx is not None and _tracing.tracing_enabled():
+            if spec.trace_ctx.get("sampled", True):
+                # task events <-> traces: a slow row in summarize_tasks /
+                # timeline() jumps straight to its waterfall
+                ev["trace_id"] = spec.trace_ctx["trace_id"]
+                self._trace_transition(spec, state, ev["time"],
+                                       error=extra.get("error"))
+            elif state == "FAILED":
+                # head-sampled out, but errored requests always export
+                self._trace_hop(spec, "raylet.task_failed",
+                                ev["time"], ev["time"], status="ERROR",
+                                error=extra.get("error"))
         self._task_events.append(ev)
         states = self._task_states
         # pop+reinsert: dict order becomes least-recently-UPDATED first, so
@@ -4825,6 +5093,10 @@ class Raylet:
             "events_dropped": counter(
                 "ray_tpu_internal_task_events_dropped_total",
                 "Task events shed by the export ring buffer"),
+            "trace_dropped": counter(
+                "ray_tpu_internal_trace_spans_dropped_total",
+                "Trace spans shed by the export buffers (process-local "
+                "and raylet-side) before reaching the GCS trace table"),
             "frames": counter(
                 "ray_tpu_internal_proto_frames_total",
                 "Control-plane frames handled"),
@@ -4979,6 +5251,7 @@ class Raylet:
         bump(im["frames"], "frames", self._m_frames)
         bump(im["trains"], "trains", self._m_trains)
         bump(im["events_dropped"], "dropped", self._task_event_dropped_total)
+        bump(im["trace_dropped"], "trace_dropped", self._trace_dropped_total)
         for st, n in self._m_tasks_done.items():
             bump(im["tasks_total"], f"tasks_{st}", n, tags={"state": st})
         bump(im["pull_sender_saturated"], "pull_sat",

@@ -277,7 +277,21 @@ class Worker:
         return ObjectRef(oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
+        from ray_tpu.util import tracing as _tracing
+
         ids = [r.id() for r in refs]
+        if _tracing.tracing_enabled():
+            # caller-wakeup hop: the get() that consumes a traced submit's
+            # result closes the request loop (ctx recorded at submit time,
+            # consumed on first lookup)
+            ctx = _tracing.lookup_get_ctx(ids)
+            if ctx is not None:
+                # a raised error marks the span ERROR in span.__exit__
+                with _tracing.span("task.get", parent=ctx, n=len(ids)):
+                    return self._get_inner(ids, timeout)
+        return self._get_inner(ids, timeout)
+
+    def _get_inner(self, ids, timeout: Optional[float] = None):
         if self.mode in (DRIVER, WORKER) and self.store is not None:
             # Fast path: an object already SEALED in the local store needs
             # no raylet round trip (sealed implies the producing task

@@ -378,24 +378,52 @@ class _run_span:
     paths (child span of the submit-side span; reference:
     `_inject_tracing_into_function`, `tracing_helper.py:322`).  Call
     ``done(ok)`` with the inner result so user exceptions converted into
-    error replies still mark the span ERROR."""
+    error replies still mark the span ERROR.
+
+    Only requests carrying a submit-side context get an execution span
+    (with no ctx a span here would mint a fresh root per execution —
+    noise, not a request trace), and SAMPLED-OUT requests skip the span
+    object entirely: a failure is reported post-hoc as one synthesized
+    ERROR span under the propagated ids, so errored requests stay
+    visible while the other 99% pay ~nothing."""
 
     def __init__(self, spec: TaskSpec):
         from ray_tpu.util import tracing
 
-        self._sp = tracing.span(
-            f"task.run {spec.name}", parent=spec.trace_ctx,
-            task_id=spec.task_id.hex(), kind=spec.kind) \
-            if tracing.tracing_enabled() else None
+        self._sp = None
+        self._err_ctx = None
+        ctx = spec.trace_ctx
+        if ctx is None or not tracing.tracing_enabled():
+            return
+        if ctx.get("sampled", True):
+            self._sp = tracing.span(
+                f"task.run {spec.name}", parent=ctx,
+                task_id=spec.task_id.hex(), kind=spec.kind)
+        else:
+            self._err_ctx = ctx
+            self._name = spec.name
+            self._task_id = spec.task_id.hex()
 
     def __enter__(self):
         if self._sp is not None:
             self._sp.__enter__()
+        elif self._err_ctx is not None:
+            self._t0 = time.time()
         return self
 
     def done(self, ok: bool):
-        if self._sp is not None and not ok:
+        if ok:
+            return
+        if self._sp is not None:
             self._sp.set_error("task raised (see error object)")
+        elif self._err_ctx is not None:
+            from ray_tpu.util import tracing
+
+            tracing.emit_span(
+                f"task.run {self._name}", self._err_ctx["trace_id"],
+                self._err_ctx.get("span_id"), self._t0, time.time(),
+                status="ERROR", error="task raised (see error object)",
+                task_id=self._task_id)
 
     def __exit__(self, *exc):
         if self._sp is not None:
@@ -411,18 +439,23 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
 async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
     spec: TaskSpec = msg["spec"]
     from ray_tpu.runtime_context import _current_task_id
+    from ray_tpu.util import tracing
 
     _ctx_token = _current_task_id.set(spec.task_id)
     try:
-        args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
-        result = await getattr(worker.actor_instance, spec.method_name)(
-            *args, **kwargs
-        )
-        inline, stored, sizes, contains = _package_results(worker, spec,
-                                                            result)
-        worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
-                          "inline": inline, "stored": stored, "sizes": sizes,
-                          "contains": contains})
+        with tracing.maybe_span("worker.get_args"):
+            args, kwargs = _resolve_args(worker, spec,
+                                         msg.get("arg_values", {}))
+        with tracing.maybe_span("worker.exec"):
+            result = await getattr(worker.actor_instance, spec.method_name)(
+                *args, **kwargs
+            )
+        with tracing.maybe_span("worker.result_push"):
+            inline, stored, sizes, contains = _package_results(worker, spec,
+                                                               result)
+            worker.send_done({"t": "done", "task_id": spec.task_id,
+                              "ok": True, "inline": inline, "stored": stored,
+                              "sizes": sizes, "contains": contains})
         return True
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -459,61 +492,79 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
                 f"undeclared concurrency group "
                 f"{msg['__bad_group__']!r} for {spec.name}")
         _apply_runtime_env(spec)
-        args, kwargs = _resolve_args(worker, spec, msg.get("arg_values", {}))
-        if spec.kind == ACTOR_CREATION_TASK:
-            cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
-            worker.actor_instance = cls(*args, **kwargs)
-            worker.current_actor_id = spec.actor_id
-            _setup_actor_concurrency(worker, spec)
-            worker.checkpoint_interval = spec.checkpoint_interval or 0
-            if worker.checkpoint_interval and worker.actor_loop is not None:
-                # the options-time validation can't see coroutine methods;
-                # fail creation loudly rather than snapshot-while-awaiting
-                raise ValueError(
-                    "checkpoint_interval is not supported on asyncio "
-                    "actors (state may mutate at await points during "
-                    "__ray_save__)")
-            if spec.restore_oid is not None:
-                # warm restart: re-hydrate from the latest checkpoint the
-                # owning raylet attached to this (re)creation
-                blob = msg.get("arg_values", {}).get(spec.restore_oid.hex())
-                state = (serialization.loads(blob) if blob is not None
-                         else worker.read_store_object(spec.restore_oid))
-                worker.actor_instance.__ray_restore__(state)
-                extra["restored"] = True
-            # the raylet pipelines calls only to sync actors — report the
-            # execution model it can't otherwise see
-            extra["async_actor"] = worker.actor_loop is not None
-            result = None
-        elif spec.kind == ACTOR_TASK:
-            if spec.method_name == "__ray_terminate__":
-                worker.flush_dones()
-                worker._send({"t": "done", "task_id": spec.task_id, "ok": True,
-                              "inline": {spec.return_ids()[0].hex():
-                                         serialization.dumps(None)},
-                              "stored": []})
-                os._exit(0)
-            inst = worker.actor_instance
-            if inst is None:
-                raise RuntimeError("actor instance missing")
-            method = getattr(inst, spec.method_name)
-            result = method(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                # Coroutine reached the sync path (e.g. called from an
-                # executor thread): run it on the actor loop to completion.
-                result = asyncio.run_coroutine_threadsafe(
-                    result, worker.actor_loop
-                ).result() if worker.actor_loop else asyncio.run(result)
-        else:
-            fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
-            result = fn(*args, **kwargs)
-        if spec.num_returns == STREAMING_RETURNS:
-            result = _run_streaming(worker, spec, result)
-        inline, stored, sizes, contains = _package_results(worker, spec,
-                                                            result)
-        worker.send_done({"t": "done", "task_id": spec.task_id, "ok": True,
-                          "inline": inline, "stored": stored, "sizes": sizes,
-                          "contains": contains, **extra})
+        from ray_tpu.util import tracing
+
+        with tracing.maybe_span("worker.get_args"):
+            args, kwargs = _resolve_args(worker, spec,
+                                         msg.get("arg_values", {}))
+        with tracing.maybe_span("worker.exec"):
+            if spec.kind == ACTOR_CREATION_TASK:
+                cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
+                worker.actor_instance = cls(*args, **kwargs)
+                worker.current_actor_id = spec.actor_id
+                _setup_actor_concurrency(worker, spec)
+                worker.checkpoint_interval = spec.checkpoint_interval or 0
+                if worker.checkpoint_interval \
+                        and worker.actor_loop is not None:
+                    # the options-time validation can't see coroutine
+                    # methods; fail creation loudly rather than
+                    # snapshot-while-awaiting
+                    raise ValueError(
+                        "checkpoint_interval is not supported on asyncio "
+                        "actors (state may mutate at await points during "
+                        "__ray_save__)")
+                if spec.restore_oid is not None:
+                    # warm restart: re-hydrate from the latest checkpoint
+                    # the owning raylet attached to this (re)creation —
+                    # spanned as a recovery event under the restarting
+                    # request's trace
+                    with tracing.maybe_span(
+                            "recovery.restore",
+                            checkpoint=spec.restore_oid.hex()):
+                        blob = msg.get("arg_values", {}).get(
+                            spec.restore_oid.hex())
+                        state = (serialization.loads(blob)
+                                 if blob is not None
+                                 else worker.read_store_object(
+                                     spec.restore_oid))
+                        worker.actor_instance.__ray_restore__(state)
+                    extra["restored"] = True
+                # the raylet pipelines calls only to sync actors — report
+                # the execution model it can't otherwise see
+                extra["async_actor"] = worker.actor_loop is not None
+                result = None
+            elif spec.kind == ACTOR_TASK:
+                if spec.method_name == "__ray_terminate__":
+                    worker.flush_dones()
+                    worker._send({"t": "done", "task_id": spec.task_id,
+                                  "ok": True,
+                                  "inline": {spec.return_ids()[0].hex():
+                                             serialization.dumps(None)},
+                                  "stored": []})
+                    os._exit(0)
+                inst = worker.actor_instance
+                if inst is None:
+                    raise RuntimeError("actor instance missing")
+                method = getattr(inst, spec.method_name)
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    # Coroutine reached the sync path (e.g. called from an
+                    # executor thread): run it on the actor loop to
+                    # completion.
+                    result = asyncio.run_coroutine_threadsafe(
+                        result, worker.actor_loop
+                    ).result() if worker.actor_loop else asyncio.run(result)
+            else:
+                fn = _resolve_callable(worker, spec, msg.get("fn_blob"))
+                result = fn(*args, **kwargs)
+            if spec.num_returns == STREAMING_RETURNS:
+                result = _run_streaming(worker, spec, result)
+        with tracing.maybe_span("worker.result_push"):
+            inline, stored, sizes, contains = _package_results(worker, spec,
+                                                               result)
+            worker.send_done({"t": "done", "task_id": spec.task_id,
+                              "ok": True, "inline": inline, "stored": stored,
+                              "sizes": sizes, "contains": contains, **extra})
         return True
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
@@ -571,6 +622,7 @@ def main():
 
     from ray_tpu.util import tracing
 
+    tracing.set_process_label("worker")
     tracing.maybe_enable_from_env()
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -585,6 +637,12 @@ def main():
         "worker_id": worker.worker_id,
         "profile": config.worker_profile or "cpu",
     })
+    if tracing.tracing_enabled():
+        # span export: batches ride the control socket to the raylet,
+        # which forwards to the GCS trace table on its flush cadence
+        tracing.set_flush_target(
+            lambda spans, dropped: worker._send(
+                {"t": "spans", "spans": spans, "dropped": dropped}))
     while True:
         msg = worker.task_queue.get()
         if msg.get("t") == "exit_checkpoint":

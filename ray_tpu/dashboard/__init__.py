@@ -17,8 +17,12 @@ Endpoints:
   GET /api/tasks             cluster-wide task table (GCS task events)
   GET /api/task_summary      state->count + export-drop accounting
   GET /api/timeline          chrome://tracing trace of the task events
+  GET /api/trace/<trace_id>  one request's span tree + latency waterfall
+  GET /api/trace_summary     per-hop p50/p95 attribution over all traces
+  GET /api/health            GCS failure-detection stats (health_stats)
   GET /metrics               Prometheus text exposition (system gauges +
-                             internal ray_tpu_internal_* + user metrics)
+                             internal ray_tpu_internal_* incl. the
+                             GCS-side health series + user metrics)
 """
 
 from __future__ import annotations
@@ -93,9 +97,17 @@ class DashboardHead:
             "/api/tasks": self._tasks,
             "/api/task_summary": self._task_summary,
             "/api/timeline": self._timeline,
+            "/api/trace_summary": self._trace_summary,
+            "/api/health": self._health,
         }
         if path in api:
             return json.dumps(api[path](), default=str), "application/json"
+        if path.startswith("/api/trace/"):
+            trace_id = path[len("/api/trace/"):]
+            if not trace_id:
+                raise KeyError(path)
+            return (json.dumps(self._trace(trace_id), default=str),
+                    "application/json")
         if path.startswith("/api/jobs/") and path.endswith("/logs"):
             job_id = path[len("/api/jobs/"):-len("/logs")]
             raw = self._gcs.kv_get("jobs", (job_id + "/logs").encode())
@@ -152,6 +164,31 @@ class DashboardHead:
         from ray_tpu.util.state import build_timeline
 
         return build_timeline(self._gcs.task_events_raw())
+
+    def _trace(self, trace_id: str):
+        """One request's reassembled span tree + critical-path waterfall
+        (GCS trace table — every process batch-flushes its spans there)."""
+        from ray_tpu.util import trace_analysis
+
+        spans = self._gcs.get_trace(trace_id)
+        return {
+            "trace_id": trace_id,
+            "num_spans": len(spans),
+            "tree": trace_analysis.build_tree(spans),
+            "critical_path": trace_analysis.critical_path(spans),
+        }
+
+    def _trace_summary(self):
+        from ray_tpu.util import trace_analysis
+
+        out = trace_analysis.aggregate(self._gcs.list_trace_spans())
+        out["table"] = self._gcs.trace_table_stats()
+        return out
+
+    def _health(self):
+        """Failure-detection observability (suspicions, fencing, drains,
+        time-to-detect) straight from the GCS health monitor."""
+        return self._gcs.health_stats()
 
     # ------------------------------------------------------------- metrics
 
@@ -222,7 +259,8 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px}}</style></head>
 <h2>jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>
 {job_rows}</table>
 <p>APIs: /api/nodes /api/actors /api/jobs /api/cluster_resources /api/load
-/api/placement_groups /api/tasks /api/task_summary /api/timeline /metrics</p>
+/api/placement_groups /api/tasks /api/task_summary /api/timeline
+/api/trace/&lt;id&gt; /api/trace_summary /api/health /metrics</p>
 </body></html>"""
 
     def shutdown(self):

@@ -11,6 +11,9 @@ Commands:
   status --address HOST:PORT                   cluster resource summary
   list {nodes,actors,tasks} --address ...      state tables
   timeline --address ... --out FILE            chrome://tracing dump
+  trace {export,summary} --address ...         request-flow traces:
+                                               Perfetto export / per-hop
+                                               latency attribution
 """
 
 from __future__ import annotations
@@ -305,6 +308,35 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Request-flow traces (GCS trace table): ``export`` writes
+    Perfetto/chrome://tracing JSON (one trace with --trace-id, else every
+    retained span); ``summary`` prints the per-hop "where do the
+    microseconds go" attribution table."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    if args.action == "export":
+        n = state.export_trace(args.out, trace_id=args.trace_id,
+                               job_id=args.job, limit=args.limit)
+        print(f"wrote {n} events to {args.out}")
+        return 0
+    summary = state.trace_summary(job_id=args.job, limit=args.limit)
+    table = summary.get("table", {})
+    print(f"traces: {summary['requests']} ({summary['errored']} errored)  "
+          f"spans retained: {table.get('num_spans', 0)}  "
+          f"dropped: {table.get('num_dropped', 0)}")
+    print(f"e2e latency: p50 {summary['e2e_p50_us']}us  "
+          f"p95 {summary['e2e_p95_us']}us")
+    print(f"{'hop':<28}{'reqs':>7}{'p50_us':>10}{'p95_us':>10}"
+          f"{'total_us':>12}{'share':>8}")
+    for hop, row in summary["by_hop"].items():
+        print(f"{hop:<28}{row['requests']:>7}{row['p50_us']:>10}"
+              f"{row['p95_us']:>10}{row['total_us']:>12}"
+              f"{row['share']:>8.1%}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -397,6 +429,18 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("--out", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "trace", help="request-flow traces: export Perfetto JSON / "
+                      "per-hop latency summary")
+    p.add_argument("action", choices=["export", "summary"])
+    p.add_argument("--address", required=True)
+    p.add_argument("--trace-id", default=None,
+                   help="export just this trace (default: all retained)")
+    p.add_argument("--job", default=None, help="filter by job id")
+    p.add_argument("--limit", type=int, default=100000)
+    p.add_argument("--out", default="trace.json")
+    p.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -61,6 +61,7 @@ class Replica:
         as they are produced (reference: Serve streaming responses over
         streaming generator returns)."""
         from ray_tpu.serve.multiplex import _model_id_ctx, _set_model_id
+        from ray_tpu.util import tracing
 
         with self._lock:
             self._ongoing += 1
@@ -71,7 +72,19 @@ class Replica:
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method)
+            # time-to-first-token: the interval from request entry to the
+            # first streamed item, emitted as a sub-span of this call's
+            # task.run (the generator body runs inside its context)
+            t0 = time.time()
+            ttft_ctx = tracing.current_trace_ctx() \
+                if tracing.tracing_enabled() else None
+            first = True
             for item in fn(request):
+                if first:
+                    first = False
+                    if ttft_ctx is not None:
+                        tracing.hop("serve.ttft", ttft_ctx, t0, time.time(),
+                                    proc="worker", method=method)
                 yield item
         finally:
             _model_id_ctx.reset(token)

@@ -353,6 +353,19 @@ class DeploymentHandle:
     def remote(self, request: Any = None):
         """Dispatch; returns an ObjectRef (resolve with ray_tpu.get), or an
         ObjectRefGenerator when the handle has ``stream=True``."""
+        from ray_tpu.util import tracing
+
+        if not tracing.tracing_enabled():
+            return self._remote_inner(request)
+        # router→replica hop: the serve request's root span (or a child,
+        # when the handle call itself runs inside a traced request) —
+        # replica pick + probes + the actor-call submit all parent here,
+        # so the routing cost is visible next to replica execution time
+        with tracing.span(f"serve.route {self._deployment}",
+                          method=self._method, stream=self._stream):
+            return self._remote_inner(request)
+
+    def _remote_inner(self, request: Any):
         if self._model_id:
             replica = self._pick_replica_affine()
         else:

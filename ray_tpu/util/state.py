@@ -46,6 +46,86 @@ def _task_table_call(op: str, **kw):
     return w._request(op, **kw)
 
 
+def _trace_table_call(op: str, **kw):
+    """Query the GCS trace-span table cluster-wide.  This process's span
+    buffer and the connected raylet's export buffer are flushed first so
+    the freshest local spans count; remote raylets flush on their own
+    cadence (poll for their tail)."""
+    w = _worker()
+    if w.mode == "local":
+        return None
+    from ray_tpu.util import tracing as _tracing
+
+    if w.mode == "driver":
+        # driver + raylet share a process: the raylet drains the shared
+        # span buffer itself
+        w.raylet.call(w.raylet.flush_trace_spans).result()
+        return getattr(w.raylet.gcs, op)(**kw)
+    # worker / client modes: ship this process's buffer to the raylet,
+    # which flushes locally and proxies the read
+    _tracing.flush_spans()
+    return w._request(op, **kw)
+
+
+def list_trace_spans(job_id: Optional[str] = None,
+                     limit: int = 10000) -> List[Dict[str, Any]]:
+    """The most recent retained span records, cluster-wide (GCS trace
+    table, start-time ordered)."""
+    return list(_trace_table_call("list_trace_spans", job_id=job_id,
+                                  limit=limit) or [])
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """Reassemble one request's cross-process span tree plus its latency
+    waterfall: ``{"trace_id", "spans", "tree", "critical_path"}`` —
+    ``tree`` nests children under parents across every process the
+    request touched; ``critical_path`` is the per-hop attribution (see
+    ``util.trace_analysis``)."""
+    from ray_tpu.util import trace_analysis
+
+    spans = list(_trace_table_call("get_trace", trace_id=trace_id) or [])
+    return {
+        "trace_id": trace_id,
+        "spans": spans,
+        "tree": trace_analysis.build_tree(spans),
+        "critical_path": trace_analysis.critical_path(spans),
+    }
+
+
+def trace_summary(job_id: Optional[str] = None,
+                  limit: int = 100000) -> Dict[str, Any]:
+    """The "where do the microseconds go" table: per-hop p50/p95/total
+    attributed self-time aggregated over every retained trace, plus the
+    trace-table accounting (span/trace counts, drop counter)."""
+    from ray_tpu.util import trace_analysis
+
+    spans = list(_trace_table_call("list_trace_spans", job_id=job_id,
+                                   limit=limit) or [])
+    out = trace_analysis.aggregate(spans)
+    out["table"] = dict(_trace_table_call("trace_table_stats") or {})
+    return out
+
+
+def export_trace(filename: str, trace_id: Optional[str] = None,
+                 job_id: Optional[str] = None, limit: int = 100000) -> int:
+    """Write retained spans (one trace, or everything) as
+    Perfetto/chrome://tracing JSON.  Returns the event count."""
+    import json as _json
+
+    from ray_tpu.util import trace_analysis
+
+    if trace_id is not None:
+        spans = list(_trace_table_call("get_trace", trace_id=trace_id)
+                     or [])
+    else:
+        spans = list(_trace_table_call("list_trace_spans", job_id=job_id,
+                                       limit=limit) or [])
+    doc = trace_analysis.to_chrome_trace(spans)
+    with open(filename, "w") as f:
+        _json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
 def list_nodes() -> List[Dict[str, Any]]:
     """Cluster membership with resources (GCS node table)."""
     w = _worker()
@@ -181,6 +261,11 @@ def build_timeline(events: List[dict], spans: Optional[List[dict]] = None,
         run_t: Optional[float] = None
         pid = 0
         node = evs[-1].get("node_id", "")
+        # task events <-> traces: a sampled request's timeline slices
+        # carry its trace id, so a slow slice jumps to its waterfall
+        trace_id = next((e["trace_id"] for e in evs
+                         if e.get("trace_id")), None)
+        targs = {"trace_id": trace_id} if trace_id else {}
         for ev in evs:
             st = ev.get("state")
             t = ev.get("time", 0.0)
@@ -193,12 +278,12 @@ def build_timeline(events: List[dict], spans: Optional[List[dict]] = None,
                     pid = ev.get("pid") or 0
                     if queued_t is not None:
                         emit(name, "queue_wait", queued_t, t, pid, tid,
-                             node_id=ev.get("node_id", node))
+                             node_id=ev.get("node_id", node), **targs)
                         queued_t = None
             elif st in ("FINISHED", "FAILED", "OOM_KILLED"):
                 start = run_t if run_t is not None else t
                 sl = emit(name, "run", start, t, pid, tid, state=st,
-                          node_id=ev.get("node_id", node),
+                          node_id=ev.get("node_id", node), **targs,
                           **({"error": ev["error"]} if ev.get("error")
                              else {}))
                 first_run.setdefault(tid, sl)
@@ -208,20 +293,20 @@ def build_timeline(events: List[dict], spans: Optional[List[dict]] = None,
                 # attempt boundary: close whatever phase was open here
                 if run_t is not None:
                     sl = emit(name, "run", run_t, t, pid, tid, state=st,
-                              node_id=ev.get("node_id", node))
+                              node_id=ev.get("node_id", node), **targs)
                     first_run.setdefault(tid, sl)
                 elif queued_t is not None:
                     emit(name, "queue_wait", queued_t, t, pid, tid, state=st,
-                         node_id=ev.get("node_id", node))
+                         node_id=ev.get("node_id", node), **targs)
                 run_t = queued_t = None
         # in-flight work: open-ended slices up to `now` (never dropped)
         if run_t is not None:
             sl = emit(name, "run", run_t, now, pid, tid, state="RUNNING",
-                      in_flight=True, node_id=node)
+                      in_flight=True, node_id=node, **targs)
             first_run.setdefault(tid, sl)
         elif queued_t is not None:
             emit(name, "queue_wait", queued_t, now, pid, tid,
-                 in_flight=True, node_id=node)
+                 in_flight=True, node_id=node, **targs)
 
     # flow arrows from submit spans (tracing on): submitting process ->
     # the task's first run slice
