@@ -292,6 +292,9 @@ def main():
     # ---- request-flow tracing overhead (fresh traced runtime) ----
     bench_trace(results, record, scale)
 
+    # ---- continuous-profiling overhead (fresh runtime per mode) ----
+    bench_profile(results, record, scale)
+
     # ---- cross-node data plane (two-node same-host harness) ----
     bench_remote(results, record, scale)
 
@@ -370,6 +373,53 @@ def bench_trace(results, record, scale):
                      f"request-flow tracing at {setting} vs disabled"),
         }
         print(json.dumps({"metric": name, **results[name]}), flush=True)
+
+
+def bench_profile(results, record, scale):
+    """Continuous-profiling tax on tasks_async, trace_overhead-style:
+    interleaved on/off (RAY_TPU_PROFILE kill switch) at the default
+    sampling rate, order-symmetric best-of-3 with the mode order reversed
+    on odd rounds so monotone host drift can't masquerade as sampler tax.
+    Unlike tracing, the switch is read from each process's OWN
+    environment — workers inherit it at spawn — so each mode gets a fresh
+    runtime (the honest way to flip the whole process tree)."""
+    import ray_tpu
+    from ray_tpu.util import profiling
+
+    n = int(10000 * scale)
+    modes = [("off", "0"), ("on", "1")]
+    rates = {name: 0.0 for name, _ in modes}
+    try:
+        for rnd in range(3):
+            for name, val in (modes if rnd % 2 == 0 else modes[::-1]):
+                os.environ["RAY_TPU_PROFILE"] = val
+                profiling._live["at"] = -1.0  # skip the 0.25s flag cache
+                ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+
+                @ray_tpu.remote
+                def nop():
+                    return b"ok"
+
+                ray_tpu.get([nop.remote() for _ in range(8)])
+                rates[name] = max(rates[name], timed(
+                    n, lambda: ray_tpu.get(
+                        [nop.remote() for _ in range(n)])))
+                ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_PROFILE", None)
+        profiling._live["at"] = -1.0
+    record("tasks_async_profile_off_per_s", rates["off"])
+    record("tasks_async_profiled_per_s", rates["on"])
+    results["profile_overhead"] = {
+        "value": round(
+            max(0.0, 1.0 - rates["on"] / max(rates["off"], 1e-9)), 4),
+        "unit": ("fraction of tasks_async throughput lost with the "
+                 "in-process sampling profiler at the default "
+                 "RAY_TPU_PROFILE_HZ vs the RAY_TPU_PROFILE=0 kill "
+                 "switch"),
+    }
+    print(json.dumps({"metric": "profile_overhead",
+                      **results["profile_overhead"]}), flush=True)
 
 
 def bench_remote(results, record, scale):

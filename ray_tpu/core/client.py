@@ -67,7 +67,7 @@ class ClientWorker(Worker):
                 self.store = ShmObjectStore(store_path)
             except OSError:
                 self.store = None  # different host: no shm access
-        from ray_tpu.util import tracing
+        from ray_tpu.util import profiling, tracing
 
         tracing.maybe_enable_from_env()
         if tracing.tracing_enabled():
@@ -77,6 +77,13 @@ class ClientWorker(Worker):
             tracing.set_flush_target(
                 lambda spans, dropped: self._send(
                     {"t": "spans", "spans": spans, "dropped": dropped}))
+        # continuous profiling of the driver process: folded samples ride
+        # the same worker route (raylet -> GCS profile table)
+        profiling.ensure_profiler("driver")
+        profiling.set_flush_target(
+            lambda samples, dropped: self._send(
+                {"t": "profile_samples", "samples": samples,
+                 "dropped": dropped}))
 
     # Worker.get/put/wait/submit use _send/_request like worker mode does.
 

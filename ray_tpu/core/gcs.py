@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol
 from ray_tpu.core.config import config
+from ray_tpu.util import profiling
 from ray_tpu.util.locks import make_lock, make_rlock
 
 config.define("gcs_heartbeat_interval_s", float, 0.25,
@@ -120,6 +121,18 @@ class GcsCore:
         # Soft state — never persisted.
         self._trace_spans: Dict[str, deque] = {}  # guard: _lock
         self._trace_dropped = 0  # guard: _lock
+        # Profile table (continuous profiling): node_id -> deque of folded
+        # stack-sample records, bounded per node (config.profile_table_max);
+        # producer-side drops and GCS-side evictions both count.  Soft
+        # state — never persisted.
+        self._profile_samples: Dict[str, deque] = {}  # guard: _lock
+        self._profile_dropped = 0  # guard: _lock
+        # token -> {"event": Event, "reports": {node_id: payload}, "want"}
+        # for targeted node queries (live stack dumps, log listings)
+        # relayed through the node pubsub; replies land via the
+        # node_query_report op — same shape as the indirect-probe waiters.
+        self._query_waiters: Dict[str, dict] = {}  # guard: _lock
+        self._query_seq = 0  # guard: _lock
         # oid(hex) -> {nodes: set[node_id], size, inline}
         self._objects: Dict[str, dict] = {}  # guard: _lock
         # oid(hex) -> set of watcher node_ids (want a push when located)
@@ -1475,6 +1488,148 @@ class GcsCore:
                     "num_dropped": self._trace_dropped,
                     "jobs": sorted(self._trace_spans)}
 
+    # ----------------------------------------------------- profile table
+
+    def add_profile_samples(self, node_id: str, samples: List[dict],
+                            dropped: int = 0,
+                            incarnation: Optional[int] = None):
+        """Batch append from one node's folded stack-sample buffers
+        (every process on the node funnels through its raylet; the
+        standalone GCS feeds its own samples under the "gcs" key).
+        ``dropped`` counts records the producer shed to backpressure.
+        Stamped batches from a fenced node are rejected whole."""
+        cap = max(1, config.profile_table_max)
+        with self._lock:
+            if not self._fence_ok(node_id, incarnation):
+                return
+            self._profile_dropped += dropped
+            log = self._profile_samples.get(node_id)
+            if log is None:
+                log = self._profile_samples[node_id] = deque(maxlen=cap)
+            for rec in samples:
+                if len(log) == cap:
+                    self._profile_dropped += 1  # eviction, counted
+                log.append(rec)
+
+    def list_profile_samples(self, node_id: Optional[str] = None,
+                             since: float = 0.0,
+                             limit: int = 100000) -> List[dict]:
+        """Retained folded sample records, cluster-wide or for one node
+        (id prefix accepted); ``since`` keeps only records whose window
+        ends at/after it — the timed-capture filter behind
+        ``state.profile(duration_s)``."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            if node_id is not None:
+                logs = [log for nid, log in self._profile_samples.items()
+                        if nid.startswith(node_id)]
+            else:
+                logs = list(self._profile_samples.values())
+            rows = [rec for log in logs for rec in log
+                    if rec.get("t1", 0.0) >= since]
+        rows.sort(key=lambda rec: rec.get("t0", 0.0))
+        return rows[-limit:]
+
+    def profile_table_stats(self) -> dict:
+        with self._lock:
+            num = sum(len(v) for v in self._profile_samples.values())
+            total = sum(int(rec.get("count", 0))
+                        for log in self._profile_samples.values()
+                        for rec in log)
+            return {"num_records": num, "num_samples": total,
+                    "num_dropped": self._profile_dropped,
+                    "nodes": sorted(self._profile_samples)}
+
+    # ------------------------- targeted node queries (stacks / logs) ----
+
+    def _node_query_multi(self, node_ids: List[str], kind: str,
+                          payload: Optional[dict],
+                          timeout_s: float) -> Tuple[Dict[str, Any],
+                                                     List[str]]:
+        """Publish one targeted ``node_query`` per node and gather the
+        ``node_query_report`` replies: ``(reports, missing)``.  The
+        introspection analogue of the indirect-probe relay — the GCS
+        never dials anyone, the existing pubsub + one-way op carry both
+        directions."""
+        if not node_ids:
+            return {}, []
+        with self._lock:
+            self._query_seq += 1
+            token = f"q{self._query_seq}:{kind}:{time.monotonic():.6f}"
+            waiter = {"event": threading.Event(), "reports": {},
+                      "want": len(node_ids)}
+            self._query_waiters[token] = waiter
+        for nid in node_ids:
+            self._publish("node_query",
+                          {"kind": kind, "token": token,
+                           "payload": payload or {}},
+                          target_node=nid)
+        waiter["event"].wait(max(0.1, timeout_s))
+        with self._lock:
+            self._query_waiters.pop(token, None)
+            reports = dict(waiter["reports"])
+        missing = [nid for nid in node_ids if nid not in reports]
+        return reports, missing
+
+    def node_query_report(self, token: str, node_id: str, payload):
+        """A raylet's reply to a targeted ``node_query`` push."""
+        with self._lock:
+            waiter = self._query_waiters.get(token)
+            if waiter is None:
+                return
+            waiter["reports"][node_id] = payload
+            if len(waiter["reports"]) >= waiter["want"]:
+                waiter["event"].set()
+
+    def _alive_node_ids(self, node_id: Optional[str]) -> List[str]:
+        with self._lock:
+            return [nid for nid, info in self._nodes.items()
+                    if info["alive"]
+                    and (node_id is None or nid.startswith(node_id))]
+
+    def node_query(self, node_id: Optional[str], kind: str,
+                   payload: Optional[dict] = None,
+                   timeout_s: float = 3.0) -> Dict[str, Any]:
+        """Targeted introspection query against one node (id prefix) or
+        every alive node: ``{"reports": {node_id: payload}, "missing":
+        [...]}`` — ``missing`` nodes didn't answer inside the timeout
+        (dead, partitioned, or busy past the deadline)."""
+        targets = self._alive_node_ids(node_id)
+        reports, missing = self._node_query_multi(targets, kind, payload,
+                                                  timeout_s)
+        return {"reports": reports, "missing": missing}
+
+    def collect_stacks(self, node_id: Optional[str] = None,
+                       pid: Optional[int] = None,
+                       timeout_s: float = 3.0) -> Dict[str, Any]:
+        """Live all-thread stacks from every process on the targeted
+        node(s) — the cluster-wide ``ray stack`` / ``py-spy dump``
+        analogue.  Each raylet dumps its own threads and relays the
+        request to its workers over their control sockets; the GCS
+        process contributes its own threads unless an in-process raylet
+        already covered this pid (embedded single-node mode)."""
+        targets = self._alive_node_ids(node_id)
+        payload = {"pid": pid} if pid is not None else None
+        reports, missing = self._node_query_multi(targets, "stacks",
+                                                  payload, timeout_s)
+        out = {"nodes": reports, "missing": missing}
+        # Embedded-mode dedup: skip the self-dump only when a SAME-HOST
+        # report already covers this pid (pids are per-host — a bare
+        # cross-node pid match must not silently hide the control plane's
+        # stacks, which is exactly what a wedged-GCS debugger came for).
+        own_host = socket.gethostname()
+        with self._lock:
+            same_host = {nid for nid, info in self._nodes.items()
+                         if info.get("hostname") == own_host}
+        covered = {p.get("pid") for nid, procs in reports.items()
+                   if nid in same_host for p in procs or []}
+        if node_id is None and os.getpid() not in covered \
+                and (pid is None or pid == os.getpid()):
+            out["gcs"] = [{"pid": os.getpid(), "proc": "gcs",
+                           "threads": profiling.dump_threads(proc="gcs")}]
+        return out
+
     # ----------------------------------------------------------- snapshot
 
     def state_snapshot(self) -> dict:
@@ -1515,8 +1670,18 @@ _OPS = {
     "add_task_events", "list_task_events", "task_events_raw",
     "summarize_task_events",
     "add_trace_spans", "get_trace", "list_trace_spans", "trace_table_stats",
+    "add_profile_samples", "list_profile_samples", "profile_table_stats",
+    "collect_stacks", "node_query", "node_query_report",
     "state_snapshot",
 }
+
+# Ops that BLOCK waiting on node_query_report posts.  They must never run
+# on a GcsServer conn thread synchronously: a raylet proxying such a
+# gather shares ONE connection with its heartbeats and with the very
+# report that completes the gather — serializing them behind the blocked
+# op would suspect (then fence) the node and deadlock the query.  The
+# server bounces these to a throwaway thread and replies when they finish.
+_BLOCKING_OPS = {"collect_stacks", "node_query"}
 
 
 class GcsServer:
@@ -1566,6 +1731,31 @@ class GcsServer:
                 t = msg.get("t")
                 if t == "request":
                     rid, op = msg["rid"], msg["op"]
+                    if op in _BLOCKING_OPS:
+                        # report-waiting gathers run OFF the conn thread:
+                        # this connection must stay responsive for the
+                        # caller's heartbeats and the node_query_report
+                        # frames that complete the very gather (clients
+                        # demux replies by rid, so ordering is free)
+                        def run_blocking(rid=rid, op=op, msg=msg):
+                            try:
+                                value = getattr(self.core, op)(
+                                    *msg.get("args", ()),
+                                    **msg.get("kw", {}))
+                                reply = {"t": "reply", "rid": rid,
+                                         "ok": True, "value": value}
+                            except Exception as e:  # noqa: BLE001
+                                reply = {"t": "reply", "rid": rid,
+                                         "ok": False, "error": e}
+                            try:
+                                protocol.send_msg(sock, reply, send_lock)
+                            except OSError:
+                                pass
+
+                        threading.Thread(target=run_blocking,
+                                         name=f"gcs-{op}",
+                                         daemon=True).start()
+                        continue
                     try:
                         if op == "subscribe":
                             node_id = msg.get("kw", {}).get(

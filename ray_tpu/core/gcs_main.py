@@ -22,11 +22,26 @@ def main():
                         "(reference: Redis-backed GCS persistence)")
     args = parser.parse_args()
 
+    # Crash forensics: fatal-signal stack dumps for the control plane.
+    import faulthandler
+
+    faulthandler.enable()
+
     from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.util import profiling
 
     server = GcsServer(host=args.host, port=args.port,
                        persist_path=args.persist)
     print(f"GCS_ADDRESS {server.address}", flush=True)
+
+    # Continuous profiling of the GCS process itself (where do control-
+    # plane microseconds go?): samples flush straight into the local
+    # profile table under the reserved "gcs" producer key — no raylet in
+    # this process to relay through.
+    profiling.ensure_profiler("gcs")
+    profiling.set_flush_target(
+        lambda samples, dropped: server.core.add_profile_samples(
+            "gcs", samples, dropped))
 
     stop = threading.Event()
 

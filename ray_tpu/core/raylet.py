@@ -59,6 +59,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.util import chaos as _chaos
+from ray_tpu.util import profiling as _profiling
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.locks import make_lock
 from ray_tpu.util.retry import BackoffPolicy
@@ -546,6 +547,26 @@ class Raylet:
             # different thread) reach the GCS table without waiting for a
             # raylet-side emit to arm the timer
             self._arm_trace_flush()
+        # Continuous-profiling export (cluster-wide profiling): folded
+        # stack samples from this process's sampler thread plus worker
+        # batches ("profile_samples" control frames) buffer here and
+        # batch-flush to the per-node GCS profile table on a recurring
+        # timer (RAY_TPU_PROFILE=0 live kill switch idles the samplers;
+        # the timer then only polls an empty buffer once a second).
+        _profiling.ensure_profiler(
+            "raylet" if self.cluster_mode else "driver")
+        self._profile_buf: deque = deque()
+        self._profile_export_dropped = 0   # since last flush (shipped)
+        self._profile_dropped_total = 0    # lifetime (metrics)
+        # in-flight live stack-dump gathers: token -> {want, procs, cb, done}
+        self._stack_queries: Dict[str, dict] = {}
+        self._stack_token_seq = itertools.count(1)
+        # worker log-file index for `ray_tpu logs` + crash forensics
+        # (path -> pid survives the tail entry, which pops at death)
+        self._worker_log_pids: Dict[str, Optional[int]] = {}
+        self._worker_log_by_pid: Dict[int, str] = {}
+        self.add_timer(config.profile_flush_interval_s,
+                       self._profile_flush_tick)
         # recovery-span bookkeeping: creating task_id -> (t0, parent_ctx,
         # oid_hex) captured when a reconstruction starts, emitted when it
         # concludes
@@ -777,6 +798,7 @@ class Raylet:
         # cleanup
         self._safe(self.flush_task_events)  # don't lose the last window
         self._safe(self.flush_trace_spans)
+        self._safe(self.flush_profile_samples)
         for conn in list(self._workers.values()):
             try:
                 conn.send({"t": "shutdown"})
@@ -1032,6 +1054,10 @@ class Raylet:
             stdout.close()  # child keeps its copy
             self._worker_log_tails[log_path]["pid"] = proc.pid
             self._worker_log_tails[log_path]["proc"] = proc
+            # log index outlives the tail entry (popped at worker death):
+            # `ray_tpu logs` attribution + crash-forensics excerpts
+            self._worker_log_pids[log_path] = proc.pid
+            self._worker_log_by_pid[proc.pid] = log_path
         self._procs.append(proc)
         self._unregistered.append((proc, profile))
         if not self._health_timer_armed:
@@ -1081,6 +1107,90 @@ class Raylet:
                 self._worker_log_tails.pop(path, None)
         if not self._shutdown:
             self.add_timer(0.3, self._pump_worker_logs)
+
+    # ---- log files: list/tail over the protocol (`ray_tpu logs`) ----
+
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    def _logs_query(self, payload: dict):
+        """Dispatch a logs node-query: ``{"action": "list"}`` or
+        ``{"action": "tail", "name", "offset"?, "lines"?}``."""
+        action = payload.get("action", "list")
+        if action == "list":
+            return self._list_logs()
+        if action == "tail":
+            return self._tail_log(payload.get("name"),
+                                  payload.get("offset"),
+                                  int(payload.get("lines", 100)))
+        raise ValueError(f"unknown logs action {action!r}")
+
+    def _list_logs(self) -> List[dict]:
+        """Per-worker log files under ``session_dir/logs`` (cluster mode
+        writes one per spawned worker; reference: ``ray logs`` over the
+        session's log directory)."""
+        out = []
+        log_dir = self._log_dir()
+        if not os.path.isdir(log_dir):
+            return out
+        for name in sorted(os.listdir(log_dir)):
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(log_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append({"name": name, "size": st.st_size,
+                        "mtime": st.st_mtime, "node_id": self.node_id,
+                        "pid": self._worker_log_pids.get(path)})
+        return out
+
+    def _tail_log(self, name: Optional[str], offset: Optional[int] = None,
+                  lines: int = 100) -> dict:
+        """One read of a worker log file: the last ``lines`` lines when
+        ``offset`` is None, else everything from ``offset`` (capped at
+        1 MiB) — the returned ``offset`` feeds the next poll, which is
+        how ``--follow`` streams without server-side state."""
+        if not name or os.path.basename(name) != name:
+            # basename equality rejects path traversal out of the log dir
+            raise ValueError(f"bad log name {name!r}")
+        path = os.path.join(self._log_dir(), name)
+        size = os.path.getsize(path)  # OSError -> error reply
+        with open(path, "rb") as f:
+            if offset is None:
+                f.seek(max(0, size - (1 << 20)))
+                tail = f.read().splitlines()[-max(1, lines):]
+                data = b"\n".join(tail) + (b"\n" if tail else b"")
+                new_offset = size
+            else:
+                offset = max(0, min(int(offset), size))
+                f.seek(offset)
+                data = f.read(1 << 20)
+                new_offset = offset + len(data)
+        return {"name": name, "data": data.decode("utf-8", "replace"),
+                "offset": new_offset, "size": size,
+                "node_id": self.node_id}
+
+    def _crash_log_excerpt(self, pid: Optional[int], n: int = 20) -> str:
+        """The last ``n`` log lines of a (dead) worker, formatted for
+        embedding in its failure message — crash forensics: the operator
+        sees the traceback / faulthandler dump / OOM-killer line without
+        hunting for the right file on the right node."""
+        path = self._worker_log_by_pid.get(pid) if pid is not None else None
+        if path is None:
+            return ""
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - 65536))
+                tail = f.read().decode("utf-8", "replace").splitlines()[-n:]
+        except OSError:
+            return ""
+        if not tail:
+            return ""
+        return (f"\n--- last {len(tail)} line(s) of worker log "
+                f"({os.path.basename(path)}) ---\n" + "\n".join(tail))
 
     # ---- memory monitor / worker killing (reference: MemoryMonitor
     # `src/ray/common/memory_monitor.h:52` + retriable-FIFO policy
@@ -1191,8 +1301,14 @@ class Raylet:
             self._safe(cancel)
         conn.request_cancels.clear()
         self._release_conn_holds(conn)
+        # crash forensics: the dead worker's log tail rides the error so
+        # ActorDiedError / WorkerCrashedError carry the actual traceback
+        # or faulthandler dump (cluster mode; single-node workers share
+        # the driver's stdio and have no file)
+        excerpt = self._crash_log_excerpt(conn.pid)
         if conn.actor_id is not None:
-            self._on_actor_death(conn.actor_id, "worker process died")
+            self._on_actor_death(conn.actor_id,
+                                 "worker process died" + excerpt)
         else:
             interrupted = list(conn.inflight.values()) or (
                 [conn.current_task] if conn.current_task is not None else []
@@ -1206,7 +1322,8 @@ class Raylet:
                     self._enqueue_ready(spec)
                 else:
                     err = WorkerCrashedError(
-                        f"worker (pid={conn.pid}) died while running {spec.name}"
+                        f"worker (pid={conn.pid}) died while running "
+                        f"{spec.name}{excerpt}"
                     )
                     for oid in spec.return_ids():
                         self._object_error(oid, err)
@@ -1304,6 +1421,13 @@ class Raylet:
         elif t == "spans":
             # worker span batch (request-flow tracing) -> GCS trace table
             self._trace_ingest(msg["spans"], msg.get("dropped", 0))
+        elif t == "profile_samples":
+            # worker folded-stack batch (continuous profiling) -> GCS
+            # profile table on the next flush tick
+            self._profile_ingest(msg["samples"], msg.get("dropped", 0))
+        elif t == "stack_reply":
+            # a worker answered a live stack-dump request (ray_tpu stack)
+            self._on_stack_reply(conn, msg)
 
     def _on_task_done(self, conn: _WorkerConn, msg: dict):
         tid = msg.get("task_id")
@@ -1775,6 +1899,10 @@ class Raylet:
                 self._schedule()  # recovered: it can take work again
         elif event == "node_probe":
             self._relay_probe(data)
+        elif event == "node_query":
+            # targeted introspection (live stack dumps, log listings):
+            # collect locally and answer with a one-way report post
+            self._handle_node_query(data)
         elif event == "node_drain":
             nid = data.get("node_id")
             if nid == self.node_id:
@@ -4581,6 +4709,39 @@ class Raylet:
                 kw = {k: msg[k] for k in ("trace_id", "job_id", "limit")
                       if k in msg}
                 reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
+            elif op == "flush_profile_samples":
+                self.flush_profile_samples()
+                reply()
+            elif op in ("list_profile_samples", "profile_table_stats"):
+                # Cluster-wide profile reads proxied to the GCS profile
+                # table; flush so this node's freshest window counts.
+                self.flush_profile_samples()
+                kw = {k: msg[k] for k in ("node_id", "since", "limit")
+                      if k in msg}
+                reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
+            elif op == "dump_stacks":
+                # this node only: raylet process + all local workers
+                self.collect_local_stacks(deferred_reply,
+                                          pid=msg.get("pid"))
+            elif op == "collect_stacks":
+                # cluster-wide: the blocking GCS gather runs off-thread —
+                # the event thread must stay free to answer OUR share
+                self._spawn_gcs_query(
+                    deferred_reply, "collect_stacks",
+                    node_id=msg.get("node_id"), pid=msg.get("pid"),
+                    timeout_s=msg.get("timeout_s", 3.0))
+            elif op == "gcs_node_query":
+                self._spawn_gcs_query(
+                    deferred_reply, "node_query",
+                    node_id=msg.get("node_id"), kind=msg["kind"],
+                    payload=msg.get("payload"),
+                    timeout_s=msg.get("timeout_s", 3.0))
+            elif op == "list_logs":
+                reply(value=self._list_logs())
+            elif op == "tail_log":
+                reply(value=self._tail_log(msg.get("name"),
+                                           msg.get("offset"),
+                                           msg.get("lines", 100)))
             elif op == "kill_actor":
                 self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
                 reply()
@@ -4964,6 +5125,156 @@ class Raylet:
             self.add_timer(config.trace_flush_interval_s,
                            self._trace_flush_tick)
 
+    # ---- continuous profiling (folded stack samples -> GCS table) ----
+
+    def _profile_ingest(self, samples: List[dict], dropped: int = 0):
+        """Append a folded-sample batch (worker control frames / the
+        local sampler) to the bounded export buffer."""
+        buf = self._profile_buf
+        cap = config.profile_buffer_size
+        self._profile_export_dropped += dropped
+        self._profile_dropped_total += dropped
+        for rec in samples:
+            buf.append(rec)
+            if len(buf) > cap:
+                buf.popleft()
+                self._profile_export_dropped += 1
+                self._profile_dropped_total += 1
+
+    def flush_profile_samples(self):
+        """Drain this process's sampler window plus everything workers
+        have shipped, and post the batch to the GCS profile table."""
+        local, dropped = _profiling.drain_samples()
+        if local or dropped:
+            self._profile_ingest(local, dropped)
+        if not self._profile_buf and not self._profile_export_dropped:
+            return
+        samples = list(self._profile_buf)
+        self._profile_buf.clear()
+        dropped = self._profile_export_dropped
+        self._profile_export_dropped = 0
+        try:
+            if isinstance(self.gcs, GcsClient):
+                self.gcs.post("add_profile_samples", self.node_id, samples,
+                              dropped, incarnation=self.incarnation)
+            else:
+                self.gcs.add_profile_samples(self.node_id, samples, dropped,
+                                             incarnation=self.incarnation)
+        except (ConnectionError, TimeoutError, OSError):
+            # GCS unreachable: the batch is gone — count it honestly
+            self._profile_dropped_total += len(samples)
+            self._profile_export_dropped += dropped + len(samples)
+
+    def _profile_flush_tick(self):
+        # Recurring (unlike the lazily-armed trace timer): samples
+        # originate on the sampler thread, which can't arm event-thread
+        # timers — with profiling off this is one empty-buffer check per
+        # interval.
+        if self._shutdown:
+            return
+        self.flush_profile_samples()
+        self.add_timer(config.profile_flush_interval_s,
+                       self._profile_flush_tick)
+
+    # ---- live introspection (stack dumps / targeted node queries) ----
+
+    def collect_local_stacks(self, done_cb: Callable[[List[dict]], None],
+                             pid: Optional[int] = None,
+                             timeout_s: float = 1.5):
+        """Gather all-thread stacks from this process and every
+        registered worker (the ``ray stack`` payload).  Workers answer
+        from their socket-reader threads, so a worker stuck in user code
+        (or deadlocked) still reports.  ``done_cb(procs)`` fires on the
+        event thread — with whatever arrived by ``timeout_s`` if some
+        worker never answers."""
+        own_label = "raylet" if self.cluster_mode else "driver"
+        procs: List[dict] = []
+        if pid is None or pid == os.getpid():
+            procs.append({"pid": os.getpid(), "proc": own_label,
+                          "node_id": self.node_id,
+                          "threads": _profiling.dump_threads(
+                              proc=own_label)})
+        targets = [c for c in self._workers.values()
+                   if c.pid is not None
+                   and getattr(c, "state", None) != "driver"
+                   and (pid is None or c.pid == pid)]
+        if not targets:
+            done_cb(procs)
+            return
+        token = f"s{next(self._stack_token_seq)}"
+        state = {"want": len(targets), "procs": procs, "cb": done_cb,
+                 "done": False}
+        self._stack_queries[token] = state
+        for c in targets:
+            try:
+                c.send({"t": "stack", "token": token})
+            except OSError:
+                state["want"] -= 1
+        if state["want"] <= 0:
+            self._stack_queries.pop(token, None)
+            done_cb(procs)
+            return
+
+        def deadline(token=token):
+            st = self._stack_queries.pop(token, None)
+            if st is not None and not st["done"]:
+                st["done"] = True
+                st["cb"](st["procs"])
+
+        self.add_timer(max(0.2, timeout_s), deadline)
+
+    def _on_stack_reply(self, conn: _WorkerConn, msg: dict):
+        st = self._stack_queries.get(msg.get("token"))
+        if st is None or st["done"]:
+            return  # deadline already fired (late reply) — drop it
+        st["procs"].append({"pid": msg.get("pid") or conn.pid,
+                            "proc": "worker", "node_id": self.node_id,
+                            "actor_id": (conn.actor_id.hex()
+                                         if conn.actor_id else None),
+                            "threads": msg.get("threads") or []})
+        st["want"] -= 1
+        if st["want"] <= 0:
+            st["done"] = True
+            self._stack_queries.pop(msg.get("token"), None)
+            st["cb"](st["procs"])
+
+    def _handle_node_query(self, data: dict):
+        """A targeted GCS introspection push (``node_query``): collect the
+        answer locally and post it back as a one-way report."""
+        kind, token = data.get("kind"), data.get("token")
+        payload = data.get("payload") or {}
+        if kind == "stacks":
+            self.collect_local_stacks(
+                lambda procs: self._gcs_post(
+                    "node_query_report", token, self.node_id, procs),
+                pid=payload.get("pid"))
+        elif kind == "logs":
+            try:
+                value = self._logs_query(payload)
+            except (OSError, ValueError) as e:
+                value = {"error": repr(e)}
+            self._gcs_post("node_query_report", token, self.node_id, value)
+        elif kind == "profile_flush":
+            self.flush_profile_samples()
+            self._gcs_post("node_query_report", token, self.node_id, True)
+        # unknown kinds: no report — the requester lists this node missing
+
+    def _spawn_gcs_query(self, deferred_reply: Callable, op: str, **kw):
+        """Run a BLOCKING cluster-wide GCS gather (collect_stacks /
+        node_query) on a throwaway thread and reply when it returns — the
+        event thread must stay free to answer this node's own share of
+        the query (the GCS pushes it right back at us)."""
+        def run():
+            try:
+                value = getattr(self.gcs, op)(**kw)
+            except Exception as e:  # noqa: BLE001 — reply, don't die
+                value = {"reports": {}, "nodes": {}, "missing": [],
+                         "error": repr(e)}
+            self.call_async(deferred_reply, value)
+
+        threading.Thread(target=run, name=f"gcs-query-{op}",
+                         daemon=True).start()
+
     def _record_event(self, spec: TaskSpec, state: str, **extra):
         attempt = spec.max_retries - spec.retries_left
         ev = {
@@ -5097,6 +5408,10 @@ class Raylet:
                 "ray_tpu_internal_trace_spans_dropped_total",
                 "Trace spans shed by the export buffers (process-local "
                 "and raylet-side) before reaching the GCS trace table"),
+            "profile_dropped": counter(
+                "ray_tpu_internal_profile_samples_dropped_total",
+                "Folded profile sample records shed by the export "
+                "buffers before reaching the GCS profile table"),
             "frames": counter(
                 "ray_tpu_internal_proto_frames_total",
                 "Control-plane frames handled"),
@@ -5252,6 +5567,8 @@ class Raylet:
         bump(im["trains"], "trains", self._m_trains)
         bump(im["events_dropped"], "dropped", self._task_event_dropped_total)
         bump(im["trace_dropped"], "trace_dropped", self._trace_dropped_total)
+        bump(im["profile_dropped"], "profile_dropped",
+             self._profile_dropped_total)
         for st, n in self._m_tasks_done.items():
             bump(im["tasks_total"], f"tasks_{st}", n, tags={"state": st})
         bump(im["pull_sender_saturated"], "pull_sat",

@@ -29,6 +29,13 @@ def main():
     parser.add_argument("--store-mb", type=int, default=None)
     args = parser.parse_args()
 
+    # Crash forensics: fatal-signal stack dumps on stderr for the node
+    # daemon too (the raylet hosts no user code, but a native-codec or
+    # shm-store segfault should leave a trace, not a silent exit).
+    import faulthandler
+
+    faulthandler.enable()
+
     from ray_tpu.core.config import config
     from ray_tpu.core.object_store import create_store_file
     from ray_tpu.core.raylet import Raylet

@@ -113,6 +113,21 @@ class RemoteWorker(Worker):
                 if entry is not None:
                     entry["msg"] = msg
                     entry["event"].set()
+            elif t == "stack":
+                # live introspection (`ray_tpu stack`): answered HERE on
+                # the reader thread, so a worker stuck in user code — or
+                # deadlocked on the executor — still reports every
+                # thread's stack (the py-spy-dump analogue, in-process)
+                from ray_tpu.util import profiling
+
+                try:
+                    self._send({"t": "stack_reply",
+                                "token": msg.get("token"),
+                                "pid": os.getpid(),
+                                "threads": profiling.dump_threads(
+                                    proc="worker")})
+                except OSError:
+                    pass
             elif t == "shutdown":
                 os._exit(0)
 
@@ -439,9 +454,18 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
 async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
     spec: TaskSpec = msg["spec"]
     from ray_tpu.runtime_context import _current_task_id
-    from ray_tpu.util import tracing
+    from ray_tpu.util import profiling, tracing
 
     _ctx_token = _current_task_id.set(spec.task_id)
+    # Profiler attribution (best-effort on the shared asyncio thread:
+    # interleaved calls each stamp the loop thread while they hold it;
+    # chain=False so an out-of-LIFO-order exit clears instead of
+    # restoring a finished task's tags).
+    _ptags = profiling.set_task_tags(
+        task_id=spec.task_id.hex(),
+        trace_id=(spec.trace_ctx or {}).get("trace_id"),
+        actor_id=spec.actor_id.hex() if spec.actor_id else None,
+        name=spec.name, chain=False)
     try:
         with tracing.maybe_span("worker.get_args"):
             args, kwargs = _resolve_args(worker, spec,
@@ -466,6 +490,7 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
         })
         return False
     finally:
+        profiling.reset_task_tags(_ptags)
         _current_task_id.reset(_ctx_token)
 
 
@@ -483,8 +508,16 @@ def execute_task(worker: RemoteWorker, msg: dict):
 def _execute_task_inner(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
     from ray_tpu.runtime_context import _current_task_id
+    from ray_tpu.util import profiling
 
     _ctx_token = _current_task_id.set(spec.task_id)
+    # Profiler attribution: samples taken on this thread while the task
+    # runs fold under its task/trace/actor ids (flamegraph slicing).
+    _ptags = profiling.set_task_tags(
+        task_id=spec.task_id.hex(),
+        trace_id=(spec.trace_ctx or {}).get("trace_id"),
+        actor_id=spec.actor_id.hex() if spec.actor_id else None,
+        name=spec.name)
     extra: dict = {}
     try:
         if msg.get("__bad_group__") is not None:
@@ -575,6 +608,7 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         })
         return False
     finally:
+        profiling.reset_task_tags(_ptags)
         _current_task_id.reset(_ctx_token)
 
 
@@ -615,15 +649,23 @@ def main():
     parser.add_argument("--store", default=None)
     args = parser.parse_args()
 
+    # Crash forensics: SIGSEGV/SIGBUS/SIGABRT dump every thread's stack to
+    # stderr — which cluster mode redirects to this worker's log file, so
+    # the dump lands in the excerpt the raylet attaches to the failure.
+    import faulthandler
+
+    faulthandler.enable()
+
     if config.log_to_driver:
         prefix = f"(worker pid={os.getpid()}) "
         sys.stdout = _PrefixStream(sys.stdout, prefix)
         sys.stderr = _PrefixStream(sys.stderr, prefix)
 
-    from ray_tpu.util import tracing
+    from ray_tpu.util import profiling, tracing
 
     tracing.set_process_label("worker")
     tracing.maybe_enable_from_env()
+    profiling.ensure_profiler("worker")
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(args.socket)
@@ -643,6 +685,14 @@ def main():
         tracing.set_flush_target(
             lambda spans, dropped: worker._send(
                 {"t": "spans", "spans": spans, "dropped": dropped}))
+    # folded profile export rides the same route (raylet -> GCS profile
+    # table); registered unconditionally — RAY_TPU_PROFILE is a live
+    # switch, so a worker started with profiling off must still ship
+    # samples once it's flipped on
+    profiling.set_flush_target(
+        lambda samples, dropped: worker._send(
+            {"t": "profile_samples", "samples": samples,
+             "dropped": dropped}))
     while True:
         msg = worker.task_queue.get()
         if msg.get("t") == "exit_checkpoint":

@@ -20,6 +20,14 @@ Endpoints:
   GET /api/trace/<trace_id>  one request's span tree + latency waterfall
   GET /api/trace_summary     per-hop p50/p95 attribution over all traces
   GET /api/health            GCS failure-detection stats (health_stats)
+  GET /api/stacks            live all-thread stacks from every cluster
+                             process (?node=<prefix> targets one node)
+  GET /api/profile           continuous-profiling summary over the GCS
+                             profile table (?node=&since=&top=); add
+                             &format=speedscope|collapsed for a raw
+                             flamegraph export
+  GET /api/logs              per-worker log files per node (?node=);
+                             ?node=<prefix>&file=<name>[&lines=N] tails
   GET /metrics               Prometheus text exposition (system gauges +
                              internal ray_tpu_internal_* incl. the
                              GCS-side health series + user metrics)
@@ -31,7 +39,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ray_tpu.core.gcs import GcsClient
 
@@ -55,10 +63,16 @@ class DashboardHead:
 
             def do_GET(self):
                 try:
-                    path = urlparse(self.path).path
-                    body, ctype = dash._route(path)
+                    parsed = urlparse(self.path)
+                    query = {k: v[0] for k, v in
+                             parse_qs(parsed.query).items()}
+                    body, ctype = dash._route(parsed.path, query)
                 except KeyError:
                     self.send_error(404)
+                    return
+                except ValueError as e:
+                    # malformed query parameter (?lines=foo): caller error
+                    self.send_error(400, str(e))
                     return
                 except Exception as e:  # noqa: BLE001
                     self.send_error(500, str(e))
@@ -82,11 +96,25 @@ class DashboardHead:
 
     # ------------------------------------------------------------- routing
 
-    def _route(self, path: str):
+    def _route(self, path: str, query: Optional[dict] = None):
+        query = query or {}
         if path == "/":
             return self._index(), "text/html"
         if path == "/metrics":
             return self._metrics(), "text/plain; version=0.0.4"
+        if path == "/api/stacks":
+            return (json.dumps(self._stacks(query), default=str),
+                    "application/json")
+        if path == "/api/profile":
+            body = self._profile(query)
+            if isinstance(body, str):  # collapsed text export
+                return body, "text/plain"
+            return json.dumps(body, default=str), "application/json"
+        if path == "/api/logs":
+            body = self._logs(query)
+            if isinstance(body, str):  # tail text
+                return body, "text/plain"
+            return json.dumps(body, default=str), "application/json"
         api = {
             "/api/nodes": self._nodes,
             "/api/actors": self._actors,
@@ -190,6 +218,65 @@ class DashboardHead:
         time-to-detect) straight from the GCS health monitor."""
         return self._gcs.health_stats()
 
+    def _stacks(self, query: dict):
+        """Live all-thread stacks, cluster-wide (or one node with
+        ?node=<prefix>) — the GCS relays a targeted query to each raylet,
+        which dumps itself and its workers (see ``ray_tpu stack``)."""
+        return self._gcs.collect_stacks(
+            node_id=query.get("node"),
+            timeout_s=float(query.get("timeout", 3.0)))
+
+    def _profile(self, query: dict):
+        """Continuous-profiling readout over the GCS profile table:
+        the per-function summary by default; ?format=speedscope returns
+        a loadable speedscope document, ?format=collapsed flamegraph.pl
+        text."""
+        from ray_tpu.util import profiling
+
+        samples = self._gcs.list_profile_samples(
+            node_id=query.get("node"),
+            since=float(query.get("since", 0.0)),
+            limit=int(query.get("limit", 100000)))
+        fmt = query.get("format")
+        if fmt == "speedscope":
+            return profiling.to_speedscope(samples)
+        if fmt == "collapsed":
+            return profiling.to_collapsed(samples)
+        out = profiling.summarize(samples,
+                                  top=int(query.get("top", 30)))
+        out["table"] = self._gcs.profile_table_stats()
+        return out
+
+    def _logs(self, query: dict):
+        """Worker log files: the per-node listing, or — with ?node= and
+        ?file= — that file's tail as plain text."""
+        name = query.get("file")
+        if name:
+            out = self._gcs.node_query(
+                query.get("node"), "logs",
+                {"action": "tail", "name": name,
+                 "lines": int(query.get("lines", 100))},
+                timeout_s=float(query.get("timeout", 3.0)))
+            hits = [rep for _nid, rep in
+                    sorted(out.get("reports", {}).items())
+                    if isinstance(rep, dict) and "data" in rep]
+            if len(hits) > 1:
+                # per-raylet sequence names repeat on every node: make
+                # the caller disambiguate rather than guessing for them
+                raise ValueError(
+                    f"log file {name!r} exists on "
+                    + ", ".join(r["node_id"][:12] for r in hits)
+                    + " — pass ?node=<prefix>")
+            if hits:
+                return hits[0]["data"]
+            raise KeyError(f"log file {name!r}")
+        out = self._gcs.node_query(query.get("node"), "logs",
+                                   {"action": "list"},
+                                   timeout_s=float(query.get("timeout",
+                                                             3.0)))
+        return {nid: rep for nid, rep in out.get("reports", {}).items()
+                if isinstance(rep, list)}
+
     # ------------------------------------------------------------- metrics
 
     def _metrics(self) -> str:
@@ -260,7 +347,8 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px}}</style></head>
 {job_rows}</table>
 <p>APIs: /api/nodes /api/actors /api/jobs /api/cluster_resources /api/load
 /api/placement_groups /api/tasks /api/task_summary /api/timeline
-/api/trace/&lt;id&gt; /api/trace_summary /api/health /metrics</p>
+/api/trace/&lt;id&gt; /api/trace_summary /api/health /api/stacks
+/api/profile /api/logs /metrics</p>
 </body></html>"""
 
     def shutdown(self):

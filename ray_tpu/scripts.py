@@ -14,6 +14,13 @@ Commands:
   trace {export,summary} --address ...         request-flow traces:
                                                Perfetto export / per-hop
                                                latency attribution
+  stack [target] --address ...                 live all-thread stacks from
+                                               cluster processes (ray stack)
+  profile {export,summary} --address ...       continuous profiling:
+                                               speedscope/collapsed export,
+                                               top-function table
+  logs [file] --address ... [--follow]         list/tail per-worker log
+                                               files (ray logs)
 """
 
 from __future__ import annotations
@@ -337,6 +344,116 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Live all-thread stacks from running cluster processes (reference:
+    ``ray stack`` / the dashboard's py-spy dump, served in-process over
+    the protocol — works on remote nodes and busy/deadlocked workers)."""
+    _connect(args)
+    from ray_tpu.util import profiling, state
+
+    out = state.list_stacks(target=args.target, timeout_s=args.timeout)
+    shown = 0
+    for nid, procs in sorted(out.get("nodes", {}).items()):
+        for p in procs or []:
+            shown += 1
+            actor = f" actor={p['actor_id'][:12]}" if p.get("actor_id") \
+                else ""
+            print(f"== node {nid[:12]} pid={p['pid']} "
+                  f"({p['proc']}{actor}) ==")
+            print(profiling.format_stacks(p.get("threads") or []))
+    for p in out.get("gcs") or []:
+        shown += 1
+        print(f"== gcs pid={p['pid']} ==")
+        print(profiling.format_stacks(p.get("threads") or []))
+    if out.get("missing"):
+        print(f"no report from {len(out['missing'])} node(s): "
+              + " ".join(n[:12] for n in out["missing"]), file=sys.stderr)
+    if not shown:
+        print("no processes matched", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Continuous-profiling surfaces (GCS profile table): ``export``
+    writes a speedscope JSON (or flamegraph.pl collapsed text) of the
+    retained folded samples; ``summary`` prints the per-function "where
+    does the CPU go" table."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    since = args.since if args.since is not None else 0.0
+    if args.action == "export":
+        n = state.export_profile(args.out, fmt=args.format,
+                                 node_id=args.node, since=since,
+                                 limit=args.limit)
+        print(f"wrote {n} sample records to {args.out} ({args.format})")
+        return 0
+    summary = state.profile_summary(node_id=args.node, since=since,
+                                    limit=args.limit, top=args.top)
+    table = summary.get("table", {})
+    print(f"samples: {summary['total_samples']} "
+          f"({summary['num_records']} records, "
+          f"{table.get('num_dropped', 0)} dropped)  "
+          f"by_proc: {json.dumps(summary['by_proc'])}")
+    print(f"{'frame':<52}{'self':>8}{'share':>8}")
+    for row in summary["top_self"]:
+        print(f"{row['frame'][:50]:<52}{row['samples']:>8}"
+              f"{row['share']:>8.1%}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """List / tail the per-worker log files each raylet writes under its
+    ``session_dir/logs`` (reference: ``ray logs``).  With a file name the
+    tail prints; ``--follow`` polls the returned offset like tail -f."""
+    import time as _time
+
+    _connect(args)
+    from ray_tpu.util import state
+
+    if not args.file:
+        listing = state.list_logs(node_id=args.node,
+                                  timeout_s=args.timeout)
+        if not any(listing.values()):
+            print("no worker log files (single-node runs share the "
+                  "driver's stdio)", file=sys.stderr)
+            return 1
+        for nid, entries in sorted(listing.items()):
+            print(f"== node {nid[:12]} ==")
+            for e in entries:
+                pid = f" pid={e['pid']}" if e.get("pid") else ""
+                print(f"  {e['name']:<24}{e['size']:>10} bytes{pid}")
+        return 0
+    tail = state.tail_log(args.file, node_id=args.node, lines=args.lines,
+                          timeout_s=args.timeout)
+    if tail is None:
+        print(f"error: no node serves log file {args.file!r}",
+              file=sys.stderr)
+        return 1
+    if tail.get("ambiguous_nodes"):
+        print(f"warning: {args.file!r} exists on "
+              f"{len(tail['ambiguous_nodes'])} nodes "
+              f"({' '.join(n[:12] for n in tail['ambiguous_nodes'])}); "
+              f"showing {tail['node_id'][:12]} — pass --node to pick",
+              file=sys.stderr)
+    sys.stdout.write(tail["data"])
+    sys.stdout.flush()
+    if not args.follow:
+        return 0
+    node, offset = tail["node_id"], tail["offset"]
+    while True:
+        _time.sleep(0.5)
+        tail = state.tail_log(args.file, node_id=node, offset=offset,
+                              timeout_s=args.timeout)
+        if tail is None:
+            continue  # node busy/briefly unreachable: keep polling
+        offset = tail["offset"]
+        if tail["data"]:
+            sys.stdout.write(tail["data"])
+            sys.stdout.flush()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -441,6 +558,44 @@ def main(argv=None) -> int:
     p.add_argument("--limit", type=int, default=100000)
     p.add_argument("--out", default="trace.json")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "stack", help="live all-thread stacks from cluster processes "
+                      "(ray stack)")
+    p.add_argument("target", nargs="?", default=None,
+                   help="node-id prefix, actor name, or actor-id prefix "
+                        "(default: every process cluster-wide)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--timeout", type=float, default=3.0)
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser(
+        "profile", help="continuous profiling: speedscope/collapsed "
+                        "export / top-function summary")
+    p.add_argument("action", choices=["export", "summary"])
+    p.add_argument("--address", required=True)
+    p.add_argument("--node", default=None, help="node-id prefix filter")
+    p.add_argument("--since", type=float, default=None,
+                   help="only samples whose window ends at/after this "
+                        "unix time")
+    p.add_argument("--limit", type=int, default=100000)
+    p.add_argument("--top", type=int, default=30)
+    p.add_argument("--format", choices=["speedscope", "collapsed"],
+                   default="speedscope")
+    p.add_argument("--out", default="profile.speedscope.json")
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "logs", help="list/tail per-worker log files (ray logs)")
+    p.add_argument("file", nargs="?", default=None,
+                   help="log file name to tail (default: list files)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--node", default=None, help="node-id prefix")
+    p.add_argument("--lines", type=int, default=100)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="poll for new lines like tail -f")
+    p.add_argument("--timeout", type=float, default=3.0)
+    p.set_defaults(fn=cmd_logs)
 
     args = parser.parse_args(argv)
     return args.fn(args)

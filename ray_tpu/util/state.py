@@ -67,6 +67,227 @@ def _trace_table_call(op: str, **kw):
     return w._request(op, **kw)
 
 
+def _gcs_call(op: str, **kw):
+    """Call a GCS op that must NOT be proxied synchronously through the
+    raylet event thread (cluster-wide gathers like ``collect_stacks``
+    push a ``node_query`` back at every raylet — a blocking proxy would
+    deadlock against our own node's share).  Driver and client modes hold
+    a GCS handle and call it from this thread; worker mode bounces off
+    the raylet, which runs the gather on a throwaway thread."""
+    w = _worker()
+    if w.mode == "driver":
+        return getattr(w.raylet.gcs, op)(**kw)
+    if w.mode == "client":
+        return getattr(w.gcs, op)(**kw)
+    return None
+
+
+def _profile_table_call(op: str, **kw):
+    """Query the GCS profile table cluster-wide.  This process's sample
+    window and the connected raylet's export buffer are flushed first so
+    the freshest local samples count; remote raylets flush on their own
+    cadence (``profile_flush_interval_s``)."""
+    w = _worker()
+    if w.mode == "local":
+        return None
+    from ray_tpu.util import profiling as _profiling
+
+    if w.mode == "driver":
+        # driver + raylet share a process: the raylet drains the shared
+        # sampler window itself
+        w.raylet.call(w.raylet.flush_profile_samples).result()
+        if op == "flush_profile_samples":
+            return None
+        return getattr(w.raylet.gcs, op)(**kw)
+    # worker / client modes: ship this process's window to the raylet,
+    # which flushes locally and proxies the read
+    _profiling.flush_samples()
+    return w._request(op, **kw)
+
+
+# ------------------------------------------------------------- profiling
+
+
+def list_stacks(target: Optional[str] = None,
+                timeout_s: float = 3.0) -> Dict[str, Any]:
+    """Live all-thread stacks from every process the target matches — the
+    ``ray stack`` analogue, served by the processes themselves over the
+    protocol (no external tracer, works on remote nodes).
+
+    ``target``: ``None`` for the whole cluster (plus the GCS process), a
+    node-id prefix for one node, or an actor name / actor-id prefix for
+    exactly that actor's worker process.  Returns ``{"nodes": {node_id:
+    [{"pid", "proc", "threads": [...]}, ...]}, "missing": [...]}`` —
+    ``missing`` nodes didn't answer inside the timeout."""
+    w = _worker()
+    if w.mode == "local":
+        return {"nodes": {}, "missing": []}
+    node_id, actor_id = None, None
+    if target is not None:
+        nodes = [n["node_id"] for n in list_nodes()
+                 if n["node_id"].startswith(target)]
+        if nodes:
+            node_id = target
+        else:
+            for a in list_actors():
+                if (a["actor_id"].startswith(target)
+                        or a.get("name") == target):
+                    actor_id, node_id = a["actor_id"], a.get("node_id")
+                    break
+            if actor_id is None:
+                raise ValueError(
+                    f"stack target {target!r} matches no alive node id "
+                    "prefix, actor id prefix, or actor name")
+    kw = dict(node_id=node_id, timeout_s=timeout_s)
+    if w.mode == "worker":
+        out = w._request("collect_stacks", **kw)
+    else:
+        out = _gcs_call("collect_stacks", **kw)
+    out = dict(out or {})
+    if actor_id is not None:
+        # keep only the matched actor's worker process (the raylet tags
+        # each worker dump with its hosting actor id)
+        out["nodes"] = {
+            nid: [p for p in procs or []
+                  if p.get("actor_id") == actor_id]
+            for nid, procs in out.get("nodes", {}).items()
+        }
+        out["nodes"] = {nid: procs
+                        for nid, procs in out["nodes"].items() if procs}
+        out.pop("gcs", None)
+    return out
+
+
+def list_profile_samples(node_id: Optional[str] = None, since: float = 0.0,
+                         limit: int = 100000) -> List[Dict[str, Any]]:
+    """Retained folded stack-sample records (GCS profile table), oldest
+    first — every process samples its threads at RAY_TPU_PROFILE_HZ and
+    batch-flushes here (see ``util.profiling``)."""
+    return list(_profile_table_call("list_profile_samples",
+                                    node_id=node_id, since=since,
+                                    limit=limit) or [])
+
+
+def profile(duration_s: float = 2.0,
+            node_id: Optional[str] = None) -> Dict[str, Any]:
+    """Timed capture from the always-on samplers: wait out ``duration_s``
+    (plus the flush cadence, so every node's window lands in the GCS
+    table), then return the samples whose windows overlap the capture —
+    with per-record task/trace/actor attribution — plus ready-to-load
+    speedscope and collapsed-format exports.  Requires RAY_TPU_PROFILE=1
+    (the default); with the kill switch thrown the capture comes back
+    empty."""
+    from ray_tpu.core.config import config as _config
+    from ray_tpu.util import profiling as _profiling
+
+    t0 = time.time()
+    end = t0 + max(0.0, duration_s)
+    time.sleep(max(0.0, duration_s))
+    # stragglers: worker flushers tick every profile_flush_interval_s,
+    # then each raylet posts on its own recurring tick — wait out both
+    time.sleep(2.0 * _config.profile_flush_interval_s + 0.3)
+    _profile_table_call("flush_profile_samples")
+    samples = [rec for rec in list_profile_samples(node_id=node_id,
+                                                   since=t0)
+               if rec.get("t0", 0.0) <= end]
+    return {
+        "duration_s": duration_s,
+        "t0": t0,
+        "samples": samples,
+        "num_samples": sum(int(r.get("count", 0)) for r in samples),
+        "summary": _profiling.summarize(samples),
+        "speedscope": _profiling.to_speedscope(
+            samples, name=f"ray_tpu profile ({duration_s:.1f}s)"),
+        "collapsed": _profiling.to_collapsed(samples),
+    }
+
+
+def profile_summary(node_id: Optional[str] = None, since: float = 0.0,
+                    limit: int = 100000, top: int = 30) -> Dict[str, Any]:
+    """The "where does the CPU go" table over the retained continuous
+    profile: per-function self/inclusive sample counts and shares, per
+    process kind, plus the profile-table accounting."""
+    from ray_tpu.util import profiling as _profiling
+
+    samples = list_profile_samples(node_id=node_id, since=since,
+                                   limit=limit)
+    out = _profiling.summarize(samples, top=top)
+    out["table"] = dict(_profile_table_call("profile_table_stats") or {})
+    return out
+
+
+def export_profile(filename: str, fmt: str = "speedscope",
+                   node_id: Optional[str] = None, since: float = 0.0,
+                   limit: int = 100000) -> int:
+    """Write retained profile samples as a speedscope JSON document
+    (https://speedscope.app) or flamegraph.pl collapsed text.  Returns
+    the number of sample records exported."""
+    import json as _json
+
+    from ray_tpu.util import profiling as _profiling
+
+    samples = list_profile_samples(node_id=node_id, since=since,
+                                   limit=limit)
+    if fmt == "speedscope":
+        with open(filename, "w") as f:
+            _json.dump(_profiling.to_speedscope(samples), f)
+    elif fmt == "collapsed":
+        with open(filename, "w") as f:
+            f.write(_profiling.to_collapsed(samples))
+    else:
+        raise ValueError(f"unknown profile export format {fmt!r} "
+                         "(speedscope | collapsed)")
+    return len(samples)
+
+
+# ------------------------------------------------------------------ logs
+
+
+def _logs_query(node_id: Optional[str], payload: dict,
+                timeout_s: float) -> Dict[str, Any]:
+    w = _worker()
+    if w.mode == "local":
+        return {"reports": {}, "missing": []}
+    kw = dict(node_id=node_id, kind="logs", payload=payload,
+              timeout_s=timeout_s)
+    if w.mode == "worker":
+        return dict(w._request("gcs_node_query", **kw) or {})
+    return dict(_gcs_call("node_query", **kw) or {})
+
+
+def list_logs(node_id: Optional[str] = None,
+              timeout_s: float = 3.0) -> Dict[str, List[dict]]:
+    """Per-worker log files under each node's ``session_dir/logs``
+    (cluster mode), as ``{node_id: [{"name", "size", "mtime", "pid"}]}``
+    — the ``ray logs`` listing, served by each raylet over the
+    protocol."""
+    out = _logs_query(node_id, {"action": "list"}, timeout_s)
+    return {nid: rep for nid, rep in out.get("reports", {}).items()
+            if isinstance(rep, list)}
+
+
+def tail_log(name: str, node_id: Optional[str] = None,
+             offset: Optional[int] = None, lines: int = 100,
+             timeout_s: float = 3.0) -> Optional[Dict[str, Any]]:
+    """One read of a worker log file: the last ``lines`` lines (or, with
+    ``offset``, everything after it — feed the returned ``offset`` back
+    to poll/follow).  With no ``node_id`` the first node holding the file
+    answers."""
+    out = _logs_query(node_id, {"action": "tail", "name": name,
+                                "offset": offset, "lines": lines},
+                      timeout_s)
+    hits = [rep for _nid, rep in sorted(out.get("reports", {}).items())
+            if isinstance(rep, dict) and "data" in rep]
+    if not hits:
+        return None
+    if len(hits) > 1:
+        # worker log names are per-raylet sequences (worker-00001.log
+        # exists on EVERY node): never silently serve the wrong node's
+        # file — flag the ambiguity so callers can re-ask with node_id
+        hits[0]["ambiguous_nodes"] = [rep["node_id"] for rep in hits]
+    return hits[0]
+
+
 def list_trace_spans(job_id: Optional[str] = None,
                      limit: int = 10000) -> List[Dict[str, Any]]:
     """The most recent retained span records, cluster-wide (GCS trace

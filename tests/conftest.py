@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 # Keep worker subprocesses on CPU too (workers inherit the driver env).
 os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY_MB", "256")
+# Continuous profiling defaults ON in production; in the suite the
+# 19Hz sampler thread per process is pure wakeup tax on the loaded
+# 2-core CI hosts (hundreds of short-lived clusters), so default it off
+# here — the profiling tests opt back in explicitly (setdefault: an
+# operator's env still wins).
+os.environ.setdefault("RAY_TPU_PROFILE", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,240 @@
+"""The log pipeline: ``_PrefixStream`` source prefixing, the raylet's
+worker-log tailing (``_pump_worker_logs``), the ``ray_tpu logs``
+list/tail surfaces, and crash forensics (log excerpts on worker-death
+errors + faulthandler in daemon processes).
+"""
+
+import io
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.worker_main import _PrefixStream
+from ray_tpu.util import state
+
+
+# -------------------------------------------------------- _PrefixStream
+
+
+def test_prefix_stream_prefixes_each_line():
+    buf = io.StringIO()
+    s = _PrefixStream(buf, "(w) ")
+    s.write("one\ntwo\n")
+    assert buf.getvalue() == "(w) one\n(w) two\n"
+
+
+def test_prefix_stream_partial_line_continuation():
+    """A line built from several write() calls gets ONE prefix — the
+    stream tracks line starts across calls, so print('a', 'b') doesn't
+    sprout prefixes mid-line."""
+    buf = io.StringIO()
+    s = _PrefixStream(buf, "(w) ")
+    s.write("par")
+    s.write("tial")
+    s.write("\nnext")
+    assert buf.getvalue() == "(w) partial\n(w) next"
+    s.write("\n")
+    assert buf.getvalue() == "(w) partial\n(w) next\n"
+
+
+def test_prefix_stream_empty_and_attrs():
+    buf = io.StringIO()
+    s = _PrefixStream(buf, "(w) ")
+    assert s.write("") == 0
+    assert buf.getvalue() == ""
+    s.flush()  # passes through
+    assert s.getvalue() == ""  # __getattr__ delegation
+    # write reports the ORIGINAL length (callers account payload bytes)
+    assert s.write("xy\n") == 3
+
+
+def test_prefix_stream_interleaved_keepends():
+    buf = io.StringIO()
+    s = _PrefixStream(buf, "p|")
+    s.write("a\nb")
+    s.write("c\n\n")
+    assert buf.getvalue() == "p|a\np|bc\np|\n"
+
+
+# ------------------------------------------------------------- cluster
+
+
+@pytest.fixture(scope="module")
+def log_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 2})
+    c.connect()
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote
+def _chatty(i):
+    print(f"chatty-line-{i}")
+    sys.stdout.flush()
+    return i
+
+
+def test_worker_logs_written_listed_and_tailed(log_cluster):
+    """Cluster-mode workers log to per-worker files under the session
+    dir; the raylet serves list/tail over the protocol (``ray_tpu
+    logs``), and appended output is visible to a follow-up poll at the
+    returned offset."""
+    assert ray_tpu.get([_chatty.remote(i) for i in range(4)],
+                       timeout=60) == list(range(4))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        listing = state.list_logs()
+        files = [e for v in listing.values() for e in v]
+        if any(e["size"] > 0 for e in files):
+            break
+        time.sleep(0.3)
+    assert files, listing
+    # seq-numbered names sort in spawn order
+    names = [e["name"] for e in next(iter(listing.values()))]
+    assert names == sorted(names)
+    assert all(e["pid"] for e in files)
+
+    nid = next(iter(listing))
+    grabbed = []
+    for e in listing[nid]:
+        t = state.tail_log(e["name"], node_id=nid, lines=50)
+        assert t["size"] == e["size"] or t["size"] >= e["size"]
+        grabbed.append(t["data"])
+    combined = "".join(grabbed)
+    # files carry the worker's own (pid=..) prefix — match by content
+    assert all(f"chatty-line-{i}" in combined for i in range(4)), combined
+
+    # follow semantics: poll from the returned offset, see only new bytes
+    busy = [e["name"] for e in listing[nid]
+            if "chatty-line-0" in state.tail_log(e["name"],
+                                                 node_id=nid,
+                                                 lines=100)["data"]]
+    name = busy[0] if busy else listing[nid][0]["name"]
+    t0 = state.tail_log(name, node_id=nid, lines=1)
+    offset = t0["offset"]
+    assert ray_tpu.get(_chatty.remote(99), timeout=60) == 99
+    deadline = time.monotonic() + 10
+    new = ""
+    while time.monotonic() < deadline:
+        t1 = state.tail_log(name, node_id=nid, offset=offset)
+        offset = t1["offset"]
+        new += t1["data"]
+        if "chatty-line-99" in new:
+            break
+        time.sleep(0.2)
+    # the line landed in SOME worker's file; if it was this one, the
+    # offset poll picked it up incrementally
+    if "chatty-line-99" not in new:
+        listing = state.list_logs()
+        allnew = "".join(
+            state.tail_log(e["name"], node_id=k, lines=200)["data"]
+            for k, v in listing.items() for e in v)
+        assert "chatty-line-99" in allnew
+
+
+def test_tail_log_rejects_traversal(log_cluster):
+    # raylet-side validation: a path-traversal name or a missing file
+    # yields an error report, never file contents from outside the log
+    # dir — the client sees "no node serves this"
+    assert state.tail_log("../raylet.sock") is None
+    assert state.tail_log("no-such-file.log") is None
+
+
+@pytest.mark.slow
+def test_logs_cli_list_and_tail(log_cluster):
+    ray_tpu.get(_chatty.remote(7), timeout=60)
+    time.sleep(1.0)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "logs",
+         "--address", log_cluster.address],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "worker-" in r.stdout and ".log" in r.stdout
+    name = next(tok for tok in r.stdout.split()
+                if tok.startswith("worker-") and tok.endswith(".log"))
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "logs", name,
+         "--address", log_cluster.address, "--lines", "200"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
+def test_crash_forensics_actor_log_excerpt(log_cluster):
+    """An abnormal worker exit attaches the tail of that worker's log to
+    the ActorDiedError — the operator reads the reason in the exception,
+    not by grepping node filesystems."""
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def mark(self):
+            print("forensic-marker-xyzzy")
+            sys.stdout.flush()
+            return 1
+
+        def die(self):
+            os._exit(13)
+
+    a = Doomed.remote()
+    assert ray_tpu.get(a.mark.remote(), timeout=60) == 1
+    time.sleep(0.7)  # one log-pump tick: the marker reaches the file
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(a.die.remote(), timeout=60)
+    msg = str(ei.value)
+    assert "worker process died" in msg
+    assert "last" in msg and "worker log" in msg, msg
+    assert "forensic-marker-xyzzy" in msg, msg
+
+
+def test_crash_forensics_task_log_excerpt(log_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def hard_exit():
+        print("task-forensic-marker")
+        sys.stdout.flush()
+        time.sleep(0.8)  # let the pump ship the marker before dying
+        os._exit(11)
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(hard_exit.remote(), timeout=60)
+    msg = str(ei.value)
+    assert "died while running" in msg
+    assert "task-forensic-marker" in msg, msg
+
+
+def test_introspection_from_inside_a_task(log_cluster):
+    """Worker-mode state calls route through the raylet's threaded GCS
+    query proxies (collect_stacks / gcs_node_query) — the event thread
+    stays free to answer its own node's share, so a task can introspect
+    the cluster it runs on without deadlocking."""
+    @ray_tpu.remote
+    def introspect():
+        from ray_tpu.util import state as _state
+
+        stacks = _state.list_stacks(timeout_s=5.0)
+        logs = _state.list_logs(timeout_s=5.0)
+        return (sorted(stacks["nodes"]), stacks["missing"],
+                sorted(logs), sum(len(v) for v in logs.values()))
+
+    nodes, missing, log_nodes, nfiles = ray_tpu.get(introspect.remote(),
+                                                    timeout=60)
+    assert nodes and not missing
+    assert nfiles >= 1  # at least the worker running introspect()
+
+
+def test_faulthandler_enabled_in_workers(log_cluster):
+    """faulthandler is armed in every daemon process, so SIGSEGV /
+    native deadlock dumps land in the worker's log file (and from there
+    in the crash excerpt)."""
+    @ray_tpu.remote
+    def probe():
+        import faulthandler
+        return faulthandler.is_enabled()
+
+    assert ray_tpu.get(probe.remote(), timeout=60) is True
