@@ -228,6 +228,52 @@ def main():
 
     record("actor_calls_async_per_s", timed(n, actor_async), baseline=8177.9)
 
+    # ---- direct worker→worker transport ----
+    # Interleaved A/B on the same actor in the same run: direct channel
+    # vs the RAY_TPU_DIRECT_CALLS=0 kill switch (raylet-relayed path).
+    # Best-of-2 per mode, like task_events_overhead — a single pair on a
+    # noisy shared host mostly measures the host.
+    n = int(2000 * scale)
+    direct_rate = relayed_rate = 0.0
+    for _ in range(2):
+        ray_tpu.config.direct_calls = True
+        # observe a completion so the channel (re-)engages order-safely
+        ray_tpu.get(a.m.remote())
+        ray_tpu.get(a.m.remote())
+        direct_rate = max(direct_rate, timed(n, actor_sync))
+        ray_tpu.config.direct_calls = False
+        relayed_rate = max(relayed_rate, timed(n, actor_sync))
+    ray_tpu.config.direct_calls = True
+    record("actor_calls_direct_sync_per_s", direct_rate, baseline=2427.0)
+    results["direct_vs_relayed"] = {
+        "value": round(direct_rate / max(relayed_rate, 1e-9), 2),
+        "unit": ("sync actor-call speedup of the direct worker→worker "
+                 "channel over the raylet-relayed path, same actor, "
+                 "interleaved A/B (kill switch: RAY_TPU_DIRECT_CALLS=0; "
+                 "relayed best-of-2: "
+                 f"{round(relayed_rate, 1)} ops/s)"),
+    }
+    print(json.dumps({"metric": "direct_vs_relayed",
+                      **results["direct_vs_relayed"]}), flush=True)
+
+    # same-host actor-call round-trip latency on the direct channel
+    ray_tpu.get(a.m.remote())
+    ray_tpu.get(a.m.remote())
+    lat_n = max(200, int(1000 * scale))
+    lats = []
+    for _ in range(lat_n):
+        t0 = time.perf_counter()
+        ray_tpu.get(a.m.remote())
+        lats.append((time.perf_counter() - t0) * 1e6)
+    lats.sort()
+    results["actor_rtt_same_host_us"] = {
+        "p50": round(lats[lat_n // 2], 1),
+        "p95": round(lats[int(lat_n * 0.95)], 1),
+        "unit": "us round-trip per sync actor call, direct channel",
+    }
+    print(json.dumps({"metric": "actor_rtt_same_host_us",
+                      **results["actor_rtt_same_host_us"]}), flush=True)
+
     # ---- actor checkpoint overhead ----
     # Same class with and without checkpoint_interval, sync call loop:
     # the row tracks what fraction of call throughput the __ray_save__

@@ -259,6 +259,10 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
 
 def kill(actor: ActorHandle, no_restart: bool = True):
     worker = global_worker()
+    if worker._direct is not None:
+        # the kill travels the raylet path; frames already in flight on a
+        # direct channel must reconcile rather than race the SIGKILL
+        worker._direct.forget_actor(actor.actor_id)
     if worker.mode == "driver":
         worker.raylet.call_async(
             worker.raylet.kill_actor, actor.actor_id, no_restart

@@ -84,6 +84,23 @@ class ClientWorker(Worker):
             lambda samples, dropped: self._send(
                 {"t": "profile_samples", "samples": samples,
                  "dropped": dropped}))
+        # Direct worker→worker transport (remote-driver caller side): the
+        # raylet brokers actor addresses / worker leases over the request
+        # protocol; direct_fence control frames arrive on the read loop.
+        from ray_tpu.core.config import config as _config
+
+        if _config.direct_calls:
+            from ray_tpu.core.direct import DirectCallClient
+
+            self._direct = DirectCallClient(
+                self,
+                broker=lambda aid: self._request("direct_lookup",
+                                                 actor_id=aid),
+                resubmit=self._submit_relayed,
+                lease=lambda spec: self._request("direct_lease", spec=spec),
+                lease_release=lambda lid: self._request(
+                    "direct_lease_release", lease_id=lid),
+            )
 
     # Worker.get/put/wait/submit use _send/_request like worker mode does.
 
@@ -109,6 +126,9 @@ class ClientWorker(Worker):
                 if entry is not None:
                     entry["msg"] = msg
                     entry["event"].set()
+            elif t == "direct_fence":
+                if self._direct is not None:
+                    self._direct.on_fence(msg)
             elif t == "log":
                 # Worker stdout/stderr tailed by the raylet (reference: the
                 # LogMonitor → driver console path, `log_monitor.py:102`).
@@ -176,6 +196,9 @@ class ClientWorker(Worker):
         self._gcs_call("put_function", fid.binary(), blob)
 
     def shutdown(self):
+        if self._direct is not None:
+            self._direct.close()  # hand leases back before disconnecting
+            self._direct = None
         try:
             self.sock.close()
         except OSError:

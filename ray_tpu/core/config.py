@@ -230,6 +230,16 @@ config.define("job_id", str, "driver",
 config.define("session_dir", str, "",
               "Session directory, set in spawned workers' environment by "
               "their raylet (log files, runtime-env staging).", live=True)
+config.define("node_ip", str, "",
+              "Hosting node's IP, set in spawned workers' environment by "
+              "a cluster-mode raylet; a worker that sees it also listens "
+              "on TCP for direct worker→worker calls from peers.",
+              live=True)
+config.define("node_incarnation", int, 0,
+              "Hosting node's registration incarnation at worker spawn "
+              "time (the PR 8 fencing token), set in the worker's "
+              "environment; direct-call hellos presenting an OLDER "
+              "incarnation are rejected as fenced.", live=True)
 config.define("worker_profile", str, "cpu",
               "Worker-pool profile this worker process was spawned for "
               "(set by the raylet; read back at register time).", live=True)

@@ -21,11 +21,26 @@ driver->worker:
   shutdown      {}
 
 worker->driver:
-  register      {pid, worker_id}
+  register      {pid, worker_id, direct_addr}
   done          {task_id, ok, inline: {hex: bytes}, stored: [hex], error}
+  direct_done   done + {spec} — bookkeeping for a call whose result already
+                reached the caller over a direct channel
   submit        {spec}                                       nested submission
   request       {rid, op, ...}  ops: get / wait / put_inline / kv_get / kv_put /
-                actor_handle / named_actor / submit_sync / log
+                actor_handle / named_actor / submit_sync / log /
+                direct_lookup / direct_lease / direct_lease_release
+
+raylet->worker (direct-transport control):
+  direct_lease  {lease_id|None}  lease token grant/release — the worker's
+                DirectServer rejects lease hellos presenting any other id
+  direct_fence  {actor_ids, node_id}  tear down matching direct channels
+
+direct channel (caller worker <-> callee worker, core/direct.py — the
+raylet is NOT on this path; it only brokered the address):
+  dhello        {caller, actor_id|None, generation, incarnation, lease_id}
+  dhello_ack    {ok, reason, pid}      generation/incarnation fencing verdict
+  dcall         {spec}                 FIFO per channel; dep-free specs only
+  dresult       {task_id, ok, inline, stored, sizes, error, rejected?}
 
 Codec layer: framing (scan on receive, coalesced assembly on send) is a
 pluggable codec.  The default is a native library

@@ -42,6 +42,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
+import ray_tpu.core.direct  # noqa: F401 — registers the RAY_TPU_DIRECT_* flags
 from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -218,6 +219,13 @@ class _WorkerConn:
         self.send_lock = make_lock("worker_conn.send")
         self.rbuf = bytearray()  # partial-frame receive buffer
         self.sent_fns: set = set()  # function ids this worker has cached
+        # Direct transport: the worker's direct-call listener address
+        # (registered at startup), whether this conn ever brokered a
+        # direct channel (fence notices go only to such conns), and the
+        # active lease record when the worker is leased to a caller.
+        self.direct_addr: Optional[dict] = None
+        self.uses_direct = False
+        self.lease: Optional[dict] = None
 
     def send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
@@ -337,6 +345,15 @@ class _ActorState:
         # completion keeps effective concurrency at 1 while removing a
         # socket round-trip of dead time between calls.
         self.async_actor = False
+        # Direct transport: restart generation — bumped on EVERY death, so
+        # a direct channel (or an in-flight direct call reconciling via
+        # the raylet) brokered against an earlier incarnation of this
+        # actor is fenced instead of executing on the restarted instance.
+        self.generation = 0
+        # Exec-side direct address of a FORWARDED actor (owner side only;
+        # piggybacked on the creation xdone) — what the broker hands to
+        # callers when the actor runs on a peer node.
+        self.direct_info: Optional[dict] = None
 
     def admit_limit(self) -> int:
         if (self.max_concurrency == 1 and self.group_limits is None
@@ -670,6 +687,15 @@ class Raylet:
         # (GCS reconnect, pull re-lookups; data-channel dials hold their
         # own instance inside the pull manager).
         self._retry_policy = BackoffPolicy()
+        # ---- direct worker→worker transport (broker-side state) ----
+        # In-process driver's fence callback (DriverWorker wires it);
+        # worker/driver conns that brokered direct channels get fence
+        # notices as control frames instead.
+        self.direct_fence_cb: Optional[Callable[[dict], None]] = None
+        self._leases: Dict[str, _WorkerConn] = {}  # lease_id -> worker
+        self._lease_seq = itertools.count(1)
+        self._m_direct_dones = 0   # direct completions bookkept here
+        self._m_direct_leases = 0  # task leases granted
 
         if isinstance(self.gcs, GcsCore):
             # In-process core: subscribe directly; pushes hop to the loop.
@@ -1023,6 +1049,14 @@ class Raylet:
                 env[k] = v
         env["RAY_TPU_WORKER_PROFILE"] = profile
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # Direct-transport fencing: the worker rejects direct hellos that
+        # present an incarnation older than the node's at its spawn time
+        # (a fenced node kills its workers, so this never goes stale).
+        env["RAY_TPU_NODE_INCARNATION"] = str(self.incarnation)
+        if self.cluster_mode:
+            # lets the worker's direct-call listener bind TCP for callers
+            # on peer nodes
+            env["RAY_TPU_NODE_IP"] = self.node_ip
         cmd = [
             sys.executable,
             "-m",
@@ -1300,6 +1334,7 @@ class Raylet:
         for cancel in list(conn.request_cancels.values()):
             self._safe(cancel)
         conn.request_cancels.clear()
+        self._release_conn_lease(conn)
         self._release_conn_holds(conn)
         # crash forensics: the dead worker's log tail rides the error so
         # ActorDiedError / WorkerCrashedError carry the actual traceback
@@ -1347,6 +1382,17 @@ class Raylet:
         if t == "submit":
             self.submit_task(msg["spec"])
             return
+        if t == "direct_done":
+            # completion bookkeeping for a call that travelled the direct
+            # worker→worker channel (results already reached the caller)
+            self._on_direct_done(conn, msg)
+            return
+        if t == "direct_running":
+            # in-flight visibility for direct calls (timeline/state API);
+            # the dispatch itself never touched this raylet
+            self._record_event(msg["spec"], "RUNNING", direct=True,
+                               pid=conn.pid)
+            return
         if t == "ping":
             # Liveness probe (GCS direct probe, or a peer relaying an
             # indirect one): echo identity + incarnation so a recycled
@@ -1390,6 +1436,7 @@ class Raylet:
             conn.worker_id = msg["worker_id"]
             conn.pid = msg["pid"]
             conn.profile = msg.get("profile", "cpu")
+            conn.direct_addr = msg.get("direct_addr")
             self._spawning[conn.profile] = max(
                 0, self._spawning.get(conn.profile, 0) - 1
             )
@@ -1523,6 +1570,198 @@ class Raylet:
             # single-message sendalls.
             self._request_pump(actor)
         self._schedule()
+
+    # ---------------------------------------------- direct transport broker
+    # (core/direct.py): the raylet's residual roles on the direct path —
+    # address/lease/incarnation broker, completion bookkeeper, and the
+    # fence that keeps retries exactly-once across actor restarts.
+
+    def direct_call_info(self, actor_id: ActorID) -> Optional[dict]:
+        """Broker a direct channel to an actor's worker: address + PR 8
+        incarnation + restart generation.  None = stay on the relayed
+        path (actor not alive here, no listener, or direct disabled)."""
+        if not config.direct_calls or self._draining:
+            return None
+        actor = self._actors.get(actor_id)
+        if actor is None or actor.state != "alive":
+            return None
+        if actor.node_id is not None and actor.node_id != self.node_id:
+            # forwarded actor: hand out the exec-side listener the
+            # creation xdone piggybacked (generation stays OURS — the
+            # owner's restart counter is the fencing authority)
+            if actor.direct_info is None:
+                return None
+            info = dict(actor.direct_info)
+            info["generation"] = actor.generation
+            return info
+        conn = actor.conn
+        if conn is None or not conn.direct_addr:
+            return None
+        return {"addr": conn.direct_addr, "generation": actor.generation,
+                "incarnation": self.incarnation, "node_id": self.node_id,
+                "pid": conn.pid}
+
+    def acquire_direct_lease(self, spec: TaskSpec) -> Optional[dict]:
+        """Lease an idle pool worker to a caller for direct normal-task
+        submission (reference: worker lease reuse).  Grants only when the
+        node is otherwise quiet — queued work always wins the pool — and
+        holds the spec's resource shape until release/death."""
+        if (not config.direct_calls or self._draining
+                or self._ready_queue or self._waiting):
+            return None
+        need = spec.resources or {}
+        if not _fits(self.resources_available, need):
+            return None
+        profile = self._profile_key(spec)
+        conn = self._get_idle_worker(profile)
+        if conn is None:
+            return None
+        if not conn.direct_addr:
+            self._return_worker(conn)
+            return None
+        _acquire(self.resources_available, need)
+        lease_id = f"lease-{next(self._lease_seq)}"
+        conn.state = "leased"
+        conn.current_task = None
+        conn.lease = {"id": lease_id, "need": need}
+        self._leases[lease_id] = conn
+        try:
+            # hand the worker the lease token: its DirectServer rejects
+            # lease hellos that don't present exactly this id, so a
+            # dialer can never execute tasks outside raylet accounting
+            conn.send({"t": "direct_lease", "lease_id": lease_id})
+        except OSError:
+            # worker died under us: undo the grant, decline
+            self._leases.pop(lease_id, None)
+            conn.lease = None
+            _release(self.resources_available, need)
+            return None
+        self._m_direct_leases += 1
+        return {"addr": conn.direct_addr, "lease_id": lease_id,
+                "generation": 0, "incarnation": self.incarnation,
+                "node_id": self.node_id, "pid": conn.pid}
+
+    def release_direct_lease(self, lease_id: str):
+        conn = self._leases.pop(lease_id, None)
+        if conn is None:
+            return
+        _release(self.resources_available, conn.lease["need"])
+        conn.lease = None
+        if conn.sock in self._workers:  # still alive: back to the pool
+            try:
+                conn.send({"t": "direct_lease", "lease_id": None})
+            except OSError:
+                pass  # imminent EOF reaps it
+            self._return_worker(conn)
+            self._schedule()
+
+    def _release_conn_lease(self, conn: _WorkerConn):
+        """Worker died while leased: give its resources back (the caller's
+        channel EOF reconciles the in-flight tasks via the normal path)."""
+        if conn.lease is None:
+            return
+        self._leases.pop(conn.lease["id"], None)
+        _release(self.resources_available, conn.lease["need"])
+        conn.lease = None
+
+    def _broadcast_direct_fence(self, actor_ids=None, node_id=None):
+        """Tell direct callers to tear down channels for these actors (or
+        this whole node) NOW — a partitioned callee produces no socket
+        EOF, so blocked callers would otherwise wait out the freeze
+        instead of reconciling through the raylet."""
+        msg = {"t": "direct_fence",
+               "actor_ids": list(actor_ids or ()), "node_id": node_id}
+        if self.direct_fence_cb is not None:
+            self._safe(lambda: self.direct_fence_cb(msg))
+        for conn in list(self._workers.values()):
+            if not conn.uses_direct:
+                continue
+            try:
+                conn.send(msg)
+            except OSError:
+                pass
+
+    def _on_direct_done(self, conn: Optional[_WorkerConn], msg: dict):
+        spec: TaskSpec = msg["spec"]
+        self._m_direct_dones += 1
+        actor = (self._actors.get(spec.actor_id)
+                 if spec.actor_id is not None else None)
+        if actor is not None and actor.foreign_owner is not None:
+            # exec side of a forwarded actor: keep the store bytes
+            # registered here, relay the completion to the OWNER raylet —
+            # it owns the object table entries and the task events.
+            for h in msg.get("stored") or ():
+                oid = ObjectID.from_hex(h)
+                if self._object_status(oid) not in ("inline", "store",
+                                                    "error"):
+                    self._obj(oid).size = (msg.get("sizes") or {}).get(h, 0)
+                    self._object_in_store(oid)
+            peer = self._get_peer(actor.foreign_owner)
+            if peer is not None:
+                relay = {k: v for k, v in msg.items() if k != "t"}
+                try:
+                    peer.send({"t": "xdirect_done", "node_id": self.node_id,
+                               "msg": relay})
+                except OSError:
+                    self._drop_peer(peer)
+            return
+        self._apply_direct_done(msg, store_node=None)
+
+    def _handle_xdirect_done(self, msg: dict):
+        self._apply_direct_done(msg["msg"], store_node=msg["node_id"])
+
+    def _apply_direct_done(self, msg: dict, store_node: Optional[str]):
+        """Owner-side bookkeeping for a direct completion: seal/error the
+        return objects (idempotent — a raylet-path retry may already have
+        resolved them), retain lineage for lease tasks, count the task
+        event.  tracked=True arms the ordinary grace-free path, so a
+        result whose caller already dropped every ref still gets swept."""
+        spec: TaskSpec = msg["spec"]
+        keep_lineage = (spec.kind == NORMAL_TASK
+                        and self._lineage_count < config.max_lineage_entries)
+        if msg["ok"]:
+            contains = msg.get("contains") or {}
+            sizes = msg.get("sizes") or {}
+            for h, blob in (msg.get("inline") or {}).items():
+                oid = ObjectID.from_hex(h)
+                if self._object_status(oid) in ("inline", "store", "error"):
+                    continue
+                st = self._obj(oid)
+                st.tracked = True
+                if keep_lineage and st.creating_spec is None:
+                    st.creating_spec = spec
+                    self._lineage_count += 1
+                self._object_inline(oid, blob, contains=contains.get(h))
+            for h in msg.get("stored") or ():
+                oid = ObjectID.from_hex(h)
+                if self._object_status(oid) in ("inline", "store", "error"):
+                    continue
+                st = self._obj(oid)
+                st.tracked = True
+                st.size = max(st.size, sizes.get(h, 0))
+                if keep_lineage and st.creating_spec is None:
+                    st.creating_spec = spec
+                    self._lineage_count += 1
+                if store_node is not None and store_node != self.node_id:
+                    # bytes live in the exec node's store: register the
+                    # location; a local get pulls over the data plane
+                    st.status = "remote"
+                    if store_node not in st.locations:
+                        st.locations.append(store_node)
+                    self._object_ready(oid)
+                else:
+                    self._object_in_store(oid, contains=contains.get(h))
+                    self._maybe_replicate(oid, force=spec.replicate,
+                                          trace_ctx=spec.trace_ctx)
+            self._record_event(spec, "FINISHED", direct=True)
+        else:
+            err = msg.get("error")
+            for oid in spec.return_ids():
+                if self._object_status(oid) in ("inline", "store", "error"):
+                    continue
+                self._object_error(oid, err)
+            self._record_event(spec, "FAILED", direct=True,
+                               error=self._err_summary(err))
 
     # --------------------------------------------------------------- cluster
 
@@ -1895,6 +2134,11 @@ class Raylet:
                 # rotate back on recovery) — routing, not recovery:
                 # reconstruction/replication repair fire only on DEAD
                 self._pull_manager.on_node_suspect(nid, suspect)
+            if suspect:
+                # direct channels to the suspect node fall back to the
+                # relayed path now (a false alarm costs latency, not
+                # correctness — the raylet path dedups/fences)
+                self._broadcast_direct_fence(node_id=nid)
             if not suspect:
                 self._schedule()  # recovered: it can take work again
         elif event == "node_probe":
@@ -1957,6 +2201,9 @@ class Raylet:
 
     def _on_node_death(self, node_id: str, reason: str):
         self._cluster_nodes.pop(node_id, None)
+        # direct channels to workers on the dead node: tear down now (a
+        # partitioned callee never produces a socket EOF)
+        self._broadcast_direct_fence(node_id=node_id)
         if self._pull_manager is not None:
             # data-plane pulls sourced from the dead node rotate to other
             # holders (or fail back into _on_pull_failed for a re-lookup)
@@ -2161,6 +2408,8 @@ class Raylet:
             self._handle_xstream_item(msg)
         elif t == "xactor_death":
             self._handle_xactor_death(msg)
+        elif t == "xdirect_done":
+            self._handle_xdirect_done(msg)
         elif t == "xkill":
             self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
         elif t == "pull":
@@ -2271,9 +2520,22 @@ class Raylet:
             st = self._objects.get(ObjectID.from_hex(h))
             if st is not None and st.contains:
                 contains[h] = st.contains  # owner re-pins the inner refs
+        xdone = {"t": "xdone", "task_id": spec.task_id, "results": out,
+                 "contains": contains}
+        if spec.kind == ACTOR_CREATION_TASK:
+            # piggyback the hosted worker's direct-call listener so the
+            # OWNER can broker caller→worker channels across nodes
+            local = self._actors.get(spec.actor_id)
+            if (local is not None and local.conn is not None
+                    and local.conn.direct_addr):
+                xdone["direct_info"] = {
+                    "addr": local.conn.direct_addr,
+                    "incarnation": self.incarnation,
+                    "node_id": self.node_id,
+                    "pid": local.conn.pid,
+                }
         try:
-            peer.send({"t": "xdone", "task_id": spec.task_id, "results": out,
-                       "contains": contains})
+            peer.send(xdone)
         except OSError:
             self._drop_peer(peer)
 
@@ -2321,6 +2583,7 @@ class Raylet:
                 else:
                     actor.state = "alive"
                     actor.node_id = entry[1]
+                    actor.direct_info = msg.get("direct_info")
                     if self.cluster_mode:
                         self._gcs_post("update_actor",
                                        spec.actor_id.binary(), "alive",
@@ -3635,6 +3898,13 @@ class Raylet:
             # it here (fresh node, fresh inbox interval).
             spec._tr_in = time.time()
             spec._tr_prev = None
+        if getattr(spec, "_direct_retry", False) and all(
+                self._object_status(o) in ("inline", "store", "error")
+                for o in spec.return_ids()):
+            # Reconcile of an in-flight direct call whose result DID land
+            # (the direct_done raced the channel teardown): already
+            # resolved — never execute twice.
+            return
         # Lineage for eviction recovery: NORMAL tasks only (actor results
         # aren't replayable) and bounded — beyond the cap new objects lose
         # reconstructability instead of the raylet growing without limit
@@ -3652,6 +3922,10 @@ class Raylet:
         if spec.kind == ACTOR_CREATION_TASK:
             actor = _ActorState(spec, name=(spec.placement or {}).get("name"))
             self._actors[spec.actor_id] = actor
+            # direct-transport fencing: the creation spec carries the
+            # generation the hosted worker will validate hellos against
+            actor.generation = getattr(spec, "_direct_generation", 0)
+            spec._direct_generation = actor.generation
             if foreign_origin is not None:
                 # exec-side state: the owner restarts, we only report deaths
                 actor.restarts_left = 0
@@ -3727,6 +4001,22 @@ class Raylet:
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
                 self._record_event(spec, "FAILED",
+                                   error=self._err_summary(err))
+                return
+            if (getattr(spec, "_direct_retry", False)
+                    and spec._direct_generation != actor.generation):
+                # Reconcile of an in-flight direct call from BEFORE the
+                # actor's last restart: the old incarnation may have run
+                # it (and died before the result escaped) — executing it
+                # on the restarted instance could double side effects, so
+                # it fails like any other interrupted in-flight call.
+                err = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "?",
+                    "actor restarted while a direct call was in flight "
+                    "(restarting)")
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "FAILED", direct=True,
                                    error=self._err_summary(err))
                 return
             actor.queue.append(spec)
@@ -4272,7 +4562,14 @@ class Raylet:
         msgs = [self._dispatch_msg(s, conn, running=(i == 0))
                 for i, s in enumerate(specs)]
         conn.current_task = specs[0]
-        conn.send_many(msgs)
+        try:
+            conn.send_many(msgs)
+        except OSError:
+            # dead pool worker, EOF not yet processed (same race as the
+            # actor pump): inflight holds the batch, the death path
+            # retries/errors it
+            self._on_worker_death(conn)
+            return
         if t0:
             for s in specs:
                 if self._spec_traced(s):
@@ -4358,7 +4655,17 @@ class Raylet:
                              "arg_values": arg_values, "fn_blob": None})
         if out_msgs and actor.conn is not None:
             # one coalesced frame for the whole pump (one sendall)
-            actor.conn.send_many(out_msgs)
+            try:
+                actor.conn.send_many(out_msgs)
+            except OSError:
+                # The worker died and a submit raced its EOF onto the dead
+                # socket (a direct-channel reconcile can arrive in that
+                # window) — the specs are in inflight, so the death path
+                # errors/retries them with crash forensics as usual.
+                while deferred_groups:
+                    actor.queue.appendleft(deferred_groups.pop())
+                self._on_worker_death(actor.conn)
+                return
             for spec, t0, pid in traced_dispatches:
                 self._trace_hop(spec, "raylet.dispatch", t0, pid=pid)
         # put group-saturated specs back at the FRONT, preserving order
@@ -4371,6 +4678,12 @@ class Raylet:
         actor = self._actors.get(actor_id)
         if actor is None:
             return
+        # Direct transport: every death invalidates brokered channels —
+        # bump the generation (fences reconciles from the old incarnation)
+        # and tell local direct callers to tear down now.
+        actor.generation += 1
+        actor.direct_info = None
+        self._broadcast_direct_fence(actor_ids=[actor_id])
         # release resources held since creation
         self._release_task_resources(actor.creation_spec)
         dead_conn = actor.conn
@@ -4405,6 +4718,9 @@ class Raylet:
             if actor.checkpoint_oid is not None:
                 creation.restore_oid = actor.checkpoint_oid
                 self._m_ckpt_restores += 1
+            # the restarted worker validates direct hellos against the
+            # NEW generation; stale channels/retries fence out
+            creation._direct_generation = actor.generation
             if self.cluster_mode and actor.foreign_owner is None:
                 self._gcs_post("update_actor", actor_id.binary(),
                                "restarting")
@@ -4745,6 +5061,17 @@ class Raylet:
             elif op == "kill_actor":
                 self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
                 reply()
+            elif op == "direct_lookup":
+                # direct-transport broker: the requester becomes a fence
+                # subscriber (actor-death / node-SUSPECT teardown notices)
+                conn.uses_direct = True
+                reply(value=self.direct_call_info(msg["actor_id"]))
+            elif op == "direct_lease":
+                conn.uses_direct = True
+                reply(value=self.acquire_direct_lease(msg["spec"]))
+            elif op == "direct_lease_release":
+                self.release_direct_lease(msg["lease_id"])
+                reply()
             else:
                 reply(ok=False, error=ValueError(f"unknown op {op}"))
         except Exception as e:  # noqa: BLE001
@@ -4837,11 +5164,22 @@ class Raylet:
                 self._remove_waiter(oid, on_ready)
             pending.clear()
 
+        def reply_value():
+            # errored subset rides along: wait() counts an error as ready
+            # (ray semantics), but the direct transport's engagement
+            # watermark must not clear on one — a raylet-side failure
+            # (dep error, dead actor) proves nothing about delivery of
+            # the calls before it.
+            return {"ready": ready,
+                    "errored": [h for h in ready
+                                if self._object_status(
+                                    ObjectID.from_hex(h)) == "error"]}
+
         def fire():
             if not fired[0]:
                 fired[0] = True
                 cleanup()
-                done_cb(ready)
+                done_cb(reply_value())
 
         def on_ready(oid: ObjectID):
             if fired[0]:
@@ -4856,7 +5194,7 @@ class Raylet:
         if len(ready) >= num_returns:
             ready[:] = ready[:num_returns]
             fired[0] = True
-            done_cb(ready)
+            done_cb(reply_value())
             return None
 
         pending.extend(oid for oid in ids if not is_ready(oid))

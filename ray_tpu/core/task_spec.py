@@ -98,6 +98,17 @@ class TaskSpec:
     # `tracing_helper.py:289`)
     trace_ctx: Optional[dict] = None
 
+    # Dynamic attributes (dataclass __dict__ pickles them with the spec):
+    #   _direct_generation — actor restart generation stamped by the
+    #       owning raylet onto creation specs (the hosted worker validates
+    #       direct-call hellos against it) and onto direct-call reconciles.
+    #   _direct_retry — this spec reconciles an in-flight DIRECT call
+    #       after a channel teardown: the raylet skips it when its returns
+    #       already resolved, and fences it (retryable ActorDiedError)
+    #       when the actor's generation moved — never a double execution.
+    # Scheduler-side transients (_acquired_pool, _batch, _spill_count,
+    # _queued_t, _tr_in, _tr_prev) are set and consumed raylet-side.
+
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == STREAMING_RETURNS:
             # the completion marker object (stream items are indexed 1..n)

@@ -138,6 +138,10 @@ class Worker:
         self.actor_instance = None  # worker mode: the hosted actor
         self.current_actor_id = None
         self.namespace = ""
+        # Direct worker→worker transport (core/direct.py): caller-side
+        # channel manager, wired by DriverWorker / worker_main / client —
+        # None when direct calls are disabled (or in local mode).
+        self._direct = None
 
     # ------------------------------------------------------------ serialization
 
@@ -223,11 +227,19 @@ class Worker:
 
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        d = self._direct
+        if d is not None and d.try_submit(spec):
+            return refs  # rode the direct channel (or its fallback)
+        self._submit_relayed(spec)
+        return refs
+
+    def _submit_relayed(self, spec: TaskSpec):
+        """The raylet-mediated submit path — also the direct transport's
+        fallback/reconcile target (must not re-enter try_submit)."""
         if self.mode == DRIVER:
             self.raylet.call_async(self.raylet.submit_task, spec)
         else:
             self._send({"t": "submit", "spec": spec})
-        return refs
 
     def send_ref_events(self, events: List[tuple]):
         """Ordered hold/release transitions for this process's ObjectRefs."""
@@ -292,6 +304,29 @@ class Worker:
         return self._get_inner(ids, timeout)
 
     def _get_inner(self, ids, timeout: Optional[float] = None):
+        fast: Dict[ObjectID, tuple] = {}
+        d = self._direct
+        deadline = None
+        if d is not None:
+            # Direct-call results resolve here first: in-flight calls are
+            # waited on locally (the callee pushes straight back — no
+            # raylet round trip), cached inline results decode in place,
+            # and store-sized results fall through to the shm fast path.
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            for oid in ids:
+                if oid in fast:
+                    continue
+                r = d.resolve(oid, deadline)
+                if r is None:
+                    continue
+                if r[0] == "inline":
+                    fast[oid] = (serialization.loads(r[1]),)
+                elif r[0] == "error":
+                    raise r[1]
+                # ("store",): read via the store/raylet paths below
+            if timeout is not None:
+                timeout = max(0.0, deadline - time.monotonic())
         if self.mode in (DRIVER, WORKER) and self.store is not None:
             # Fast path: an object already SEALED in the local store needs
             # no raylet round trip (sealed implies the producing task
@@ -301,7 +336,6 @@ class Worker:
             # socket round trip.  Misses (inline results, pending or
             # errored tasks, evicted/spilled objects) take the slow path,
             # which also owns reconstruction.
-            fast: Dict[ObjectID, tuple] = {}
             miss: List[ObjectID] = []
             for oid in ids:
                 if oid in fast:
@@ -316,9 +350,12 @@ class Worker:
                         pass
                 miss.append(oid)
             if not miss:
+                if d is not None:
+                    d.note_observed(ids)
                 return [fast[oid][0] for oid in ids]
             return self._get_via_raylet(ids, miss, fast, timeout)
-        return self._get_via_raylet(ids, ids, {}, timeout)
+        return self._get_via_raylet(ids, [o for o in ids if o not in fast],
+                                    fast, timeout)
 
     def _get_via_raylet(self, ids, fetch_ids, fast, timeout):
         """Resolve ``fetch_ids`` through the raylet, then assemble results
@@ -355,6 +392,14 @@ class Worker:
                 raise GetTimeoutError(
                     f"get() timed out after {timeout}s"
                 ) from None
+        if self._direct is not None:
+            # every fetched id is now resolved — the delivery watermark
+            # the direct transport's order-safe engagement waits on
+            # (errored results don't count: a raylet-side failure proves
+            # nothing about delivery of the calls before it)
+            self._direct.note_observed(
+                ids, errored={h for h, r in results.items()
+                              if r[0] == "error"})
         out = []
         for oid in ids:
             hit = fast.get(oid)
@@ -426,15 +471,21 @@ class Worker:
             self.raylet.call_async(
                 self.raylet.async_wait, ids, num_returns, timeout, fut.set
             )
-            ready_hex = fut.result()
+            rep = fut.result()
         else:
-            ready_hex = self._request(
+            rep = self._request(
                 "wait", ids=[i.hex() for i in ids],
                 num_returns=num_returns, timeout=timeout,
             )
-        ready_set = set(ready_hex)
+        ready_set = set(rep["ready"])
         ready = [r for r in refs if r.hex() in ready_set]
         not_ready = [r for r in refs if r.hex() not in ready_set]
+        if self._direct is not None and ready:
+            # errored refs count as ready but must NOT clear the direct
+            # engagement watermark (see async_wait's reply_value)
+            self._direct.note_observed(
+                [r.id() for r in ready],
+                errored=set(rep.get("errored") or ()))
         return ready, not_ready
 
     def free(self, refs: Sequence[ObjectRef]):
@@ -617,6 +668,27 @@ class DriverWorker(Worker):
 
         self.raylet.call_async(
             lambda: self.raylet.add_timer(0.5, _ref_flush_tick))
+        # Direct worker→worker transport (caller side): actor calls and
+        # lease-reused tasks dial the callee worker directly after the
+        # raylet brokers the address; raylet path kept for first-call,
+        # recovery, and fenced peers.
+        if config.direct_calls:
+            from ray_tpu.core.direct import DirectCallClient
+
+            raylet = self.raylet
+            self._direct = DirectCallClient(
+                self,
+                broker=lambda aid: raylet.call(
+                    raylet.direct_call_info, aid).result(2.0),
+                resubmit=self._submit_relayed,
+                lease=lambda spec: raylet.call(
+                    raylet.acquire_direct_lease, spec).result(2.0),
+                lease_release=lambda lid: raylet.call_async(
+                    raylet.release_direct_lease, lid),
+            )
+            # actor-death / node-SUSPECT fences reach this in-process
+            # caller by direct callback (workers get control frames)
+            raylet.direct_fence_cb = self._direct.on_fence
         # Clean up the shm store even if the user forgets shutdown() or the
         # driver exits on an exception.
         import atexit
@@ -630,6 +702,9 @@ class DriverWorker(Worker):
             pass
 
     def shutdown(self):
+        if self._direct is not None:
+            self._direct.close()  # releases leases before the pool dies
+            self._direct = None
         self.raylet.shutdown()
         try:
             self.store.close()

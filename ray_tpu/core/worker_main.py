@@ -60,6 +60,18 @@ class RemoteWorker(Worker):
         self.checkpoint_interval = 0
         self.checkpoint_calls = 0  # completed calls since last snapshot
         self.checkpoint_seq = 0
+        # Direct transport: callee-side listener (started in main once the
+        # store is attached) and the restart generation the hosting raylet
+        # stamped into the creation spec — direct hellos must match it.
+        self.direct_server = None
+        self.actor_generation = 0
+        # lease token the raylet granted on this worker (direct_lease
+        # control message); lease hellos must present exactly this id
+        self.active_lease_id = None
+        # Serializes task execution between the main loop and a direct
+        # conn thread executing inline (plain sync actors / leased pool
+        # workers) — single-threaded execution semantics hold either way.
+        self.exec_lock = make_lock("worker.exec")
         self._rid = 0  # guard: _rid_lock
         self._rid_lock = make_lock("remote_worker.rid")
         self._pending: Dict[int, dict] = {}
@@ -128,6 +140,17 @@ class RemoteWorker(Worker):
                                     proc="worker")})
                 except OSError:
                     pass
+            elif t == "direct_lease":
+                # lease grant/release notice: the DirectServer validates
+                # lease hellos against this token (None = not leased)
+                self.active_lease_id = msg.get("lease_id")
+            elif t == "direct_fence":
+                # the raylet fenced an actor/node we hold direct channels
+                # to: tear down and reconcile in-flight calls via the
+                # raylet path (handled on this reader thread — the
+                # executor may be blocked inside one of those calls)
+                if self._direct is not None:
+                    self._direct.on_fence(msg)
             elif t == "shutdown":
                 os._exit(0)
 
@@ -158,6 +181,18 @@ class RemoteWorker(Worker):
         if buf:
             protocol.send_msgs(self.sock, buf, self.send_lock)
 
+    def queue_done(self, msg):
+        """Buffer a completion strictly for the background flusher (~2ms):
+        used for direct_done notices — the CALLER already has the result,
+        so the raylet's bookkeeping copy is latency-tolerant and must not
+        cost this thread a per-call sendall."""
+        from ray_tpu.core.worker import flush_pending_releases
+
+        flush_pending_releases()  # hold events precede the done (in order)
+        with self._done_lock:
+            self._done_buf.append(msg)
+            self._done_pending.set()
+
     def requeue_pending_tasks(self):
         """Hand unstarted batched tasks back to the raylet — called before
         blocking (nested get/wait): the current task may wait on work that
@@ -167,11 +202,20 @@ class RemoteWorker(Worker):
         if self.actor_instance is not None:
             return
         give_back = []
+        keep = []
         try:
             while True:
-                give_back.append(self.task_queue.get_nowait()["spec"])
+                m = self.task_queue.get_nowait()
+                if m.get("direct_conn") is not None or "spec" not in m:
+                    # direct calls belong to their caller's channel, not
+                    # the raylet — keep them queued here
+                    keep.append(m)
+                else:
+                    give_back.append(m["spec"])
         except queue.Empty:
             pass
+        for m in keep:
+            self.task_queue.put(m)
         if give_back:
             self._send({"t": "requeue", "specs": give_back})
 
@@ -211,6 +255,27 @@ class RemoteWorker(Worker):
         if not msg["ok"]:
             raise msg["error"]
         return msg["value"]
+
+
+def _deliver_result(worker: RemoteWorker, msg: dict, done: dict):
+    """Route a task's completion: relayed tasks send the ordinary done to
+    the raylet; direct calls push the result STRAIGHT to the caller's
+    channel (the latency path), remember it for retry dedup, and notify
+    the raylet with a direct_done so object state / ref counting / task
+    events / lineage stay exactly as on the relayed path."""
+    dconn = msg.get("direct_conn")
+    if dconn is None:
+        worker.send_done(done)
+        return
+    spec: TaskSpec = msg["spec"]
+    worker.direct_server.remember(spec.task_id, done)
+    res = dict(done)
+    res["t"] = "dresult"
+    dconn.send_result(res)
+    note = dict(done)
+    note["t"] = "direct_done"
+    note["spec"] = spec
+    worker.queue_done(note)
 
 
 def _resolve_callable(worker: RemoteWorker, spec: TaskSpec, fn_blob):
@@ -477,14 +542,15 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
         with tracing.maybe_span("worker.result_push"):
             inline, stored, sizes, contains = _package_results(worker, spec,
                                                                result)
-            worker.send_done({"t": "done", "task_id": spec.task_id,
-                              "ok": True, "inline": inline, "stored": stored,
-                              "sizes": sizes, "contains": contains})
+            _deliver_result(worker, msg,
+                            {"t": "done", "task_id": spec.task_id,
+                             "ok": True, "inline": inline, "stored": stored,
+                             "sizes": sizes, "contains": contains})
         return True
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
-        worker.send_done({
+        _deliver_result(worker, msg, {
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
@@ -495,6 +561,11 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
 
 
 def execute_task(worker: RemoteWorker, msg: dict):
+    if msg.get("direct_conn") is not None:
+        # the raylet never saw this call dispatch: a batched RUNNING note
+        # keeps the timeline / state API seeing in-flight direct work
+        # (rides the ~2ms done-flusher, not the latency path)
+        worker.queue_done({"t": "direct_running", "spec": msg["spec"]})
     with _run_span(msg["spec"]) as rs:
         ok = _execute_task_inner(worker, msg)
         rs.done(ok)
@@ -535,6 +606,10 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
                 cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
                 worker.actor_instance = cls(*args, **kwargs)
                 worker.current_actor_id = spec.actor_id
+                # direct-transport fencing: hellos must present this exact
+                # restart generation (stamped by the owning raylet)
+                worker.actor_generation = getattr(
+                    spec, "_direct_generation", 0)
                 _setup_actor_concurrency(worker, spec)
                 worker.checkpoint_interval = spec.checkpoint_interval or 0
                 if worker.checkpoint_interval \
@@ -595,14 +670,15 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         with tracing.maybe_span("worker.result_push"):
             inline, stored, sizes, contains = _package_results(worker, spec,
                                                                result)
-            worker.send_done({"t": "done", "task_id": spec.task_id,
-                              "ok": True, "inline": inline, "stored": stored,
-                              "sizes": sizes, "contains": contains, **extra})
+            _deliver_result(worker, msg,
+                            {"t": "done", "task_id": spec.task_id,
+                             "ok": True, "inline": inline, "stored": stored,
+                             "sizes": sizes, "contains": contains, **extra})
         return True
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
-        worker.send_done({
+        _deliver_result(worker, msg, {
             "t": "done", "task_id": spec.task_id, "ok": False,
             "error": err, "retryable": spec.retry_exceptions,
         })
@@ -673,11 +749,33 @@ def main():
     if args.store:
         worker.store = ShmObjectStore(args.store)
     init_worker(worker)
+    if config.direct_calls:
+        # Direct transport, both roles: serve direct calls addressed to
+        # this worker (listener address rides the register message), and
+        # dial peers for this worker's own nested actor calls / leases.
+        from ray_tpu.core.direct import DirectCallClient, DirectServer
+
+        try:
+            worker.direct_server = DirectServer(
+                worker, os.path.dirname(os.path.abspath(args.socket)))
+        except OSError:
+            worker.direct_server = None  # unservable dir: relayed only
+        worker._direct = DirectCallClient(
+            worker,
+            broker=lambda aid: worker._request("direct_lookup",
+                                               actor_id=aid),
+            resubmit=worker._submit_relayed,
+            lease=lambda spec: worker._request("direct_lease", spec=spec),
+            lease_release=lambda lid: worker._request(
+                "direct_lease_release", lease_id=lid),
+        )
     worker._send({
         "t": "register",
         "pid": os.getpid(),
         "worker_id": worker.worker_id,
         "profile": config.worker_profile or "cpu",
+        "direct_addr": (worker.direct_server.addr
+                        if worker.direct_server is not None else None),
     })
     if tracing.tracing_enabled():
         # span export: batches ride the control socket to the raylet,
@@ -704,6 +802,25 @@ def main():
             worker.flush_dones()
             os._exit(0)
         spec: TaskSpec = msg["spec"]
+        if (worker.direct_server is not None
+                and msg.get("direct_conn") is None):
+            cached, deferred = worker.direct_server.reconcile_probe(
+                spec.task_id)
+            if cached is not None:
+                # raylet-path reconcile of a direct call that ALREADY
+                # executed here: re-send the recorded result — executing
+                # again would double the call's side effects
+                cached["t"] = "done"
+                cached["task_id"] = spec.task_id
+                worker.send_done(cached)
+                continue
+            if deferred:
+                # the ORIGINAL direct execution is still in flight (e.g.
+                # a false-SUSPECT fence made the caller reconcile while
+                # the callee kept running): remember() answers this
+                # dispatch with the recorded result at completion —
+                # executing now would double the call's side effects
+                continue
         if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
                 and spec.method_name != "__ray_terminate__"):
             # getattr_static on the INSTANCE: side-effect-free (no property
@@ -742,7 +859,8 @@ def main():
             if worker.actor_executor is not None:
                 worker.actor_executor.submit(execute_task, worker, msg)
                 continue
-        execute_task(worker, msg)
+        with worker.exec_lock:
+            execute_task(worker, msg)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,13 @@ request-flow layer:
 * The executing worker opens ``task.run`` with ``worker.get_args`` /
   ``worker.exec`` / ``worker.result_push`` children; the caller's
   ``get()`` closes the loop with a ``task.get`` wakeup span.
+* Direct worker→worker calls (core/direct.py) span their two transport
+  hops — ``worker.direct_send`` (caller encode + socket hand-off) and
+  ``worker.direct_result`` (result receipt/demux) — under the same
+  submit context, so ``trace_summary`` shows the raylet inbox/queue/
+  dispatch/result hops GONE from the critical path rather than merely
+  faster.  Both hops honor the unsampled fast path: sampled-out calls
+  pay two dict probes, no span objects, no export traffic.
 
 Sampling is head-based (``RAY_TPU_TRACE_SAMPLE``): the decision is made
 once at the trace root, deterministically from the trace id, and rides the
