@@ -347,6 +347,9 @@ def main():
     # ---- lineage reconstruction under node death ----
     bench_reconstruction(results, record, scale)
 
+    # ---- overload shedding: 2x-capacity load, shed-on vs unbounded ----
+    bench_overload(results, record, scale)
+
     # ---- failure detection latency (suspicion + active probing) ----
     # LAST: its kill rounds SIGKILL five raylets whose orphaned workers
     # die only when they next touch the raylet socket — background import
@@ -823,6 +826,178 @@ def _reconstruction_record(results, record, replicated, best):
         {"metric": f"reconstruction_storm{suffix}_overhead",
          **results[f"reconstruction_storm{suffix}_overhead"]}),
         flush=True)
+
+
+def bench_overload(results, record, scale):
+    """``overload_shed``: sustained 2x-capacity open-loop load against a
+    Serve deployment, shed-on (replica reject -> router retry -> shed)
+    vs the unbounded-queue baseline — fresh runtime per mode, because
+    the backpressure flag must reach spawned replica workers via their
+    environment.  The deployment body GIL-spins (not sleeps) so capacity
+    is real: extra in-flight requests contend instead of parallelizing.
+    Shed-on records goodput (admitted completions / measured capacity),
+    admitted-request p99 vs idle p99, and shed rate; the baseline
+    records first-half vs second-half admitted latency — the unbounded
+    queue's monotonic growth signature."""
+    import threading
+
+    import ray_tpu
+    import ray_tpu.serve.replica  # noqa: F401 — defines serve_backpressure
+    from ray_tpu.core.config import config
+
+    service_s = 0.03
+    window_s = max(2.0, 4.0 * scale)
+    # open-loop thread cap: sized ABOVE the expected 2x-capacity arrival
+    # count (a hit cap starves the loop's tail and understates goodput);
+    # overflow is counted, not silent
+    max_clients = 1200
+
+    def run_mode(backpressure: bool) -> dict:
+        os.environ["RAY_TPU_SERVE_BACKPRESSURE"] = \
+            "1" if backpressure else "0"
+        config.reload("serve_backpressure")
+        ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+        from ray_tpu import serve
+
+        @serve.deployment(name="overload_bench", num_replicas=1,
+                          max_ongoing_requests=2)
+        def spin(req):
+            t_end = time.perf_counter() + service_s
+            while time.perf_counter() < t_end:
+                pass
+            return {"ok": True}
+
+        try:
+            handle = serve.run(spin.bind(), route_prefix="/overload_bench")
+            handle.call(None, timeout=60)  # warm replica + router
+
+            # measured capacity: closed-loop at the admission width
+            done = [0]
+            cap_window = max(1.0, window_s / 3)
+            cap_stop = time.perf_counter() + cap_window
+
+            def closed_loop():
+                while time.perf_counter() < cap_stop:
+                    try:
+                        handle.call(None, timeout=30)
+                        done[0] += 1
+                    except ray_tpu.RayTpuError:
+                        pass
+
+            cthreads = [threading.Thread(target=closed_loop, daemon=True,
+                                         name=f"bench-cap-{i}")
+                        for i in range(2)]
+            t0 = time.perf_counter()
+            for t in cthreads:
+                t.start()
+            for t in cthreads:
+                t.join()
+            capacity = done[0] / (time.perf_counter() - t0)
+
+            # idle p99 (sequential, uncontended)
+            lats = []
+            for _ in range(30):
+                t1 = time.perf_counter()
+                handle.call(None, timeout=30)
+                lats.append(time.perf_counter() - t1)
+            lats.sort()
+            idle_p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+            # sustained 2x capacity, open loop (arrivals independent of
+            # completions — what makes an unbounded queue actually grow)
+            interval = 1.0 / max(2 * capacity, 1.0)
+            lock = threading.Lock()
+            oks: list = []   # (start_offset_s, latency_s)
+            shed = [0]
+            errs = [0]
+            skipped = [0]
+            threads: list = []
+            t0 = time.perf_counter()
+
+            def client():
+                t1 = time.perf_counter()
+                try:
+                    handle.call(None, timeout=120)
+                    with lock:
+                        oks.append((t1 - t0, time.perf_counter() - t1))
+                except ray_tpu.BackPressureError:
+                    with lock:
+                        shed[0] += 1
+                except ray_tpu.RayTpuError:
+                    with lock:
+                        errs[0] += 1
+
+            nxt = t0
+            while time.perf_counter() - t0 < window_s:
+                now = time.perf_counter()
+                if now >= nxt:
+                    nxt += interval
+                    if len(threads) < max_clients:
+                        th = threading.Thread(target=client, daemon=True,
+                                              name="bench-ol-client")
+                        th.start()
+                        threads.append(th)
+                    else:
+                        skipped[0] += 1
+                else:
+                    time.sleep(max(0.0, min(interval / 4, nxt - now)))
+            sent_window = time.perf_counter() - t0
+            for th in threads:
+                th.join(timeout=150)
+            in_window = [(s, lat) for s, lat in oks if s <= window_s]
+            n_ok = len(in_window)
+            lat_sorted = sorted(lat for _, lat in in_window)
+            p99 = (lat_sorted[min(len(lat_sorted) - 1,
+                                  int(len(lat_sorted) * 0.99))]
+                   if lat_sorted else float("inf"))
+            half = window_s / 2
+            first = [lat for s, lat in oks if s < half]
+            second = [lat for s, lat in oks if s >= half]
+            mean = lambda xs: sum(xs) / len(xs) if xs else float("nan")  # noqa: E731
+            return {
+                "capacity_rps": capacity,
+                "idle_p99_ms": idle_p99 * 1e3,
+                "goodput_rps": n_ok / sent_window,
+                "goodput_frac_of_capacity":
+                    (n_ok / sent_window) / max(capacity, 1e-9),
+                "admitted_p99_ms": p99 * 1e3,
+                "p99_vs_idle": p99 / max(idle_p99, 1e-9),
+                "shed": shed[0], "errors": errs[0],
+                "sent": len(threads), "skipped_at_thread_cap": skipped[0],
+                "first_half_mean_ms": mean(first) * 1e3,
+                "second_half_mean_ms": mean(second) * 1e3,
+                "latency_growth":
+                    mean(second) / max(mean(first), 1e-9),
+            }
+        finally:
+            from ray_tpu import serve as _serve
+
+            _serve.shutdown()
+            ray_tpu.shutdown()
+
+    try:
+        on = run_mode(backpressure=True)
+        off = run_mode(backpressure=False)
+    finally:
+        os.environ.pop("RAY_TPU_SERVE_BACKPRESSURE", None)
+        config.reload("serve_backpressure")
+    results["overload_shed"] = {
+        **{k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in on.items()},
+        "unit": ("sustained 2x-capacity open-loop load, shedding ON "
+                 "(replica max_ongoing_requests reject -> router retry "
+                 "budget -> shed); targets: goodput_frac >= 0.8, "
+                 "p99_vs_idle <= 5"),
+    }
+    results["overload_unbounded_baseline"] = {
+        **{k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in off.items()},
+        "unit": ("same load with RAY_TPU_SERVE_BACKPRESSURE=0 (silent "
+                 "queueing): latency_growth > 1 is the unbounded "
+                 "queue's monotonically-growing-latency signature"),
+    }
+    for name in ("overload_shed", "overload_unbounded_baseline"):
+        print(json.dumps({"metric": name, **results[name]}), flush=True)
 
 
 if __name__ == "__main__":

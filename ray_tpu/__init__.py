@@ -18,9 +18,13 @@ from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, kill
 from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    BackPressureError,
+    DeadlineExceededError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -46,7 +50,9 @@ __all__ = [
     "placement_group", "remove_placement_group", "PlacementGroup",
     "cluster_resources", "available_resources", "nodes", "timeline",
     "RayTpuError", "TaskError", "ActorDiedError", "WorkerCrashedError",
-    "GetTimeoutError", "ObjectLostError", "__version__",
+    "GetTimeoutError", "ObjectLostError", "DeadlineExceededError",
+    "TaskCancelledError", "BackPressureError", "OutOfMemoryError",
+    "__version__",
 ]
 
 
@@ -196,9 +202,15 @@ def get_runtime_context():
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort cancel of a pending task (running tasks finish)."""
+    """Cancel a task (reference: ``ray.cancel``): queued work is dropped
+    (``TaskCancelledError`` on its returns), RUNNING work is interrupted
+    at the next bytecode boundary, and with ``recursive=True`` (default)
+    the cancel fans out to every task the target spawned — a timed-out
+    request does not orphan its downstream tree.  Cancel frames reach
+    directly-dialed callees (PR 11 transport) as well as raylet queues.
+    Returns True if anything was found to cancel."""
     w = global_worker()
-    return w.cancel(ref)
+    return w.cancel(ref, force=force, recursive=recursive)
 
 
 def free(refs: Sequence[ObjectRef]):

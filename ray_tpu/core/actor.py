@@ -18,6 +18,7 @@ from ray_tpu.core.remote_function import (
     _build_resources,
     _placement_from_opts,
     _prepare_env,
+    deadline_from_opts,
 )
 from ray_tpu.core.task_spec import (
     ACTOR_CREATION_TASK,
@@ -83,6 +84,7 @@ class ActorHandle:
             replicate=bool(opts.get("_replicate", False)),
             concurrency_group=(opts.get("concurrency_group")
                                or self._method_groups.get(method_name)),
+            deadline=deadline_from_opts(opts),
         )
         from ray_tpu.util.tracing import submit_with_span
 

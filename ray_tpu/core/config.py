@@ -176,6 +176,20 @@ config.define("health_check_period_s", float, 1.0, "")
 config.define("task_event_buffer_size", int, 10000,
               "Max buffered task state events for the state API.")
 
+# --- overload protection & deadlines ----------------------------------------
+config.define("deadlines", bool, True,
+              "Kill switch for the end-to-end deadline machinery: "
+              "RAY_TPU_DEADLINES=0 makes deadline_s/request_timeout_s "
+              "no-ops (specs carry no deadline, nothing is shed or "
+              "interrupted on expiry) — today's pre-deadline behavior.")
+config.define("max_queue_depth", int, 0,
+              "Bounded raylet queues: above this many queued tasks "
+              "(ready queue, or one actor's call queue) new admissions "
+              "shed the lowest-deadline-headroom task with a typed "
+              "BackPressureError instead of queueing without limit "
+              "(reference: bounded lease queues + Serve backpressure).  "
+              "0 = unbounded (default).")
+
 # --- data plane --------------------------------------------------------------
 config.define("data_channel", bool, True,
               "Zero-copy raylet-to-raylet data plane: bulk object bytes "

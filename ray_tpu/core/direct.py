@@ -400,6 +400,17 @@ class DirectServer:
                                       "pid": os.getpid()})
                     if reason is not None:
                         break
+                elif t == "dcancel":
+                    # cancel frame for a call submitted on THIS channel:
+                    # mark it in the in-flight registry (pre-exec check)
+                    # and interrupt it if it is executing right now on a
+                    # pool/loop thread (actor channels).  Lease channels
+                    # execute dcalls INLINE on this very conn thread, so
+                    # a same-channel dcancel is only read after the call
+                    # finishes — mid-exec interrupts for those arrive via
+                    # the raylet's control-socket cancel frame instead
+                    # (the reader thread delivers the async exception).
+                    self._worker.cancel_registry.cancel(msg["task_id"])
                 elif t == "dcall":
                     spec: TaskSpec = msg["spec"]
                     if self._conn_is_stale(conn) or conn.hello is None:
@@ -1126,6 +1137,30 @@ class DirectCallClient:
         owner._await(entry, deadline)  # this thread demuxes the socket
         with self._lock:
             return self._results.get(h)  # None => reconciled via raylet
+
+    # ------------------------------------------------------------- cancel
+
+    def cancel(self, oid) -> bool:
+        """Cancel fan-out over the direct transport: if the call that
+        produces ``oid`` is in flight on a dialed channel, ship a dcancel
+        frame to the callee (its in-flight registry interrupts or
+        pre-exec-fails the call; the ordinary dresult/raylet bookkeeping
+        then carries the typed TaskCancelledError back).  Returns True
+        when a channel had the call in flight."""
+        tid = oid.task_id()
+        for ch in list(self._channels.values()):  # unguarded-ok: snapshot; a racing teardown reconciles the call anyway
+            with ch.lock:
+                if tid not in ch.pending or not ch.alive:
+                    continue
+            ch.flush()  # the dcall itself must not sit behind the cancel
+            try:
+                protocol.send_msg(ch.sock, {"t": "dcancel", "task_id": tid},
+                                  ch.send_lock)
+            except OSError:
+                ch.teardown("send failed")
+                return False
+            return True
+        return False
 
     # ------------------------------------------------------------- fences
 

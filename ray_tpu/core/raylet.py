@@ -38,7 +38,7 @@ import sys
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import protocol, serialization
@@ -46,7 +46,11 @@ import ray_tpu.core.direct  # noqa: F401 — registers the RAY_TPU_DIRECT_* flag
 from ray_tpu.core.config import config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    BackPressureError,
+    DeadlineExceededError,
     ObjectLostError,
+    OutOfMemoryError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -226,6 +230,9 @@ class _WorkerConn:
         self.direct_addr: Optional[dict] = None
         self.uses_direct = False
         self.lease: Optional[dict] = None
+        # set by the memory monitor just before SIGKILL, so the death
+        # path raises typed OutOfMemoryError instead of a generic crash
+        self.oom_kill = False
 
     def send(self, msg):
         protocol.send_msg(self.sock, msg, self.send_lock)
@@ -597,8 +604,30 @@ class Raylet:
         self._m_frames = 0       # control-plane frames handled
         self._m_trains = 0       # socket drains (frame trains)
         self._m_train_bytes = 0
-        self._m_tasks_done = {"FINISHED": 0, "FAILED": 0}
+        self._m_tasks_done = {"FINISHED": 0, "FAILED": 0, "SHED": 0,
+                              "EXPIRED": 0, "CANCELLED": 0}
         self._m_last: Dict[str, float] = {}  # counter deltas at flush
+        # ---- overload protection / deadlines ----
+        self._m_shed = 0              # backpressure rejections (queue bound)
+        self._m_deadline_exceeded = 0  # deadline expiries enforced here
+        self._m_cancelled = 0         # tasks cancelled (fan-out included)
+        # cancel fan-out edges: parent task id -> child TASK IDS
+        # submitted while it ran (relayed submits + direct_running
+        # notes; ids only — retaining specs would pin their arg payloads
+        # for the LRU's lifetime); bounded LRU on parents — a long-lived
+        # driver must not grow this forever
+        self._children: "OrderedDict[TaskID, List[TaskID]]" = OrderedDict()
+        # tasks a cancel/deadline fan-out already reaped (tid -> deadline
+        # flag): a child whose submit frame or direct_running note arrives
+        # AFTER the fan-out walked the children index is caught here at
+        # admission instead of running to completion.  Bounded LRU.
+        self._cancelled_tids: "OrderedDict[TaskID, bool]" = OrderedDict()
+        # direct calls currently executing on a local worker (RUNNING note
+        # seen, done not yet): task id -> (hosting conn, spec).  Cancel/
+        # deadline frames route to the hosting worker's control socket
+        # even though dispatch never came through this raylet, and the
+        # OOM victim picker sees leased workers' in-flight work through it
+        self._direct_running: Dict[TaskID, tuple] = {}
         if config.internal_metrics_interval_s > 0:
             self._init_internal_metrics()
         self._need_schedule = False
@@ -1253,16 +1282,30 @@ class Raylet:
     def _pick_oom_victim(self) -> Optional[_WorkerConn]:
         """Retriable-FIFO: prefer the LAST-started RETRIABLE task's worker
         (its retry costs the least lost work and is safe); else the
-        last-started task's worker."""
-        busy = [c for c in self._workers.values()
-                if c.state == "busy" and c.current_task is not None
-                and c.pid is not None]
+        last-started task's worker.  Leased workers executing DIRECT
+        calls count too (their task rides _direct_running, not
+        current_task) — the caller's channel EOF reconciles the kill
+        through the ordinary retry path."""
+        direct_task: Dict[_WorkerConn, TaskSpec] = {}
+        for _conn, _spec in self._direct_running.values():
+            direct_task.setdefault(_conn, _spec)
+
+        def task_of(c: _WorkerConn) -> Optional[TaskSpec]:
+            if c.state == "busy" and c.current_task is not None:
+                return c.current_task
+            if c.state == "leased":
+                return direct_task.get(c)
+            return None
+
+        busy = [(c, t) for c in self._workers.values()
+                if c.pid is not None and (t := task_of(c)) is not None]
         if not busy:
             return None
-        retriable = [c for c in busy
-                     if getattr(c.current_task, "retries_left", 0) > 0]
+        retriable = [(c, t) for c, t in busy
+                     if getattr(t, "retries_left", 0) > 0]
         pool = retriable or busy
-        return max(pool, key=lambda c: getattr(c, "task_start_time", 0.0))
+        return max(pool, key=lambda ct:
+                   getattr(ct[0], "task_start_time", 0.0))[0]
 
     def _memory_check(self):
         frac = self._memory_usage_fraction()
@@ -1277,6 +1320,9 @@ class Raylet:
                     f"{spec.name if spec else '?'} (OOM prevention)\n")
                 if spec is not None:
                     self._record_event(spec, "OOM_KILLED", pid=victim.pid)
+                # the death path raises typed OutOfMemoryError (with the
+                # crash-forensics excerpt) instead of a generic crash
+                victim.oom_kill = True
                 try:
                     os.kill(victim.pid, 9)
                 except (ProcessLookupError, PermissionError):
@@ -1341,9 +1387,15 @@ class Raylet:
         # or faulthandler dump (cluster mode; single-node workers share
         # the driver's stdio and have no file)
         excerpt = self._crash_log_excerpt(conn.pid)
+        if self._direct_running:
+            for tid in [t for t, rec in self._direct_running.items()
+                        if rec[0] is conn]:
+                del self._direct_running[tid]
+        oom = conn.oom_kill
         if conn.actor_id is not None:
-            self._on_actor_death(conn.actor_id,
-                                 "worker process died" + excerpt)
+            reason = ("worker OOM-killed by the memory monitor" if oom
+                      else "worker process died") + excerpt
+            self._on_actor_death(conn.actor_id, reason)
         else:
             interrupted = list(conn.inflight.values()) or (
                 [conn.current_task] if conn.current_task is not None else []
@@ -1352,9 +1404,23 @@ class Raylet:
             for spec in interrupted:
                 self._release_task_resources(spec)
                 if spec.retries_left > 0:
+                    # OOM kills count against the SAME retry budget as
+                    # crashes (reference: OOM-killed tasks retried with
+                    # the task's budget, memory_monitor retry semantics)
                     spec.retries_left -= 1
-                    self._record_event(spec, "RETRYING", worker_died=True)
+                    self._record_event(spec, "RETRYING", worker_died=True,
+                                       oom=oom)
                     self._enqueue_ready(spec)
+                elif oom:
+                    err = OutOfMemoryError(
+                        f"worker (pid={conn.pid}) was OOM-killed by the "
+                        f"memory monitor while running {spec.name}"
+                        f"{excerpt}")
+                    for oid in spec.return_ids():
+                        self._object_error(oid, err)
+                    self._record_event(spec, "FAILED", worker_died=True,
+                                       oom=True,
+                                       error=self._err_summary(err))
                 else:
                     err = WorkerCrashedError(
                         f"worker (pid={conn.pid}) died while running "
@@ -1389,9 +1455,28 @@ class Raylet:
             return
         if t == "direct_running":
             # in-flight visibility for direct calls (timeline/state API);
-            # the dispatch itself never touched this raylet
-            self._record_event(msg["spec"], "RUNNING", direct=True,
+            # the dispatch itself never touched this raylet.  Also the
+            # cancel/deadline seam for direct work: record who executes it
+            # (cancel frames route to that worker's control socket) and
+            # its fan-out edge (nested submits reap with their parent).
+            spec = msg["spec"]
+            self._record_event(spec, "RUNNING", direct=True,
                                pid=conn.pid)
+            self._note_child(spec)
+            self._direct_running[spec.task_id] = (conn, spec)
+            if len(self._direct_running) > 8192:  # missed dones: age out
+                self._direct_running.pop(next(iter(self._direct_running)))
+            flag = self._cancelled_flag(spec)
+            if flag is not None:
+                # the note raced a cancel/deadline fan-out that already
+                # walked the children index: reap it now that we know who
+                # executes it
+                self._note_cancelled(spec.task_id, flag)
+                try:
+                    conn.send({"t": "cancel", "task_id": spec.task_id,
+                               "deadline": flag})
+                except OSError:
+                    self._on_worker_death(conn)
             return
         if t == "ping":
             # Liveness probe (GCS direct probe, or a peer relaying an
@@ -1505,7 +1590,7 @@ class Raylet:
                 err = msg["error"]
                 for oid in spec.return_ids():
                     self._object_error(oid, err)
-                self._record_event(spec, "FAILED",
+                self._record_event(spec, self._failure_state(err),
                                    error=self._err_summary(err))
             else:
                 inline: Dict[str, bytes] = msg.get("inline", {})
@@ -1717,6 +1802,7 @@ class Raylet:
         event.  tracked=True arms the ordinary grace-free path, so a
         result whose caller already dropped every ref still gets swept."""
         spec: TaskSpec = msg["spec"]
+        self._direct_running.pop(spec.task_id, None)
         keep_lineage = (spec.kind == NORMAL_TASK
                         and self._lineage_count < config.max_lineage_entries)
         if msg["ok"]:
@@ -1760,7 +1846,7 @@ class Raylet:
                 if self._object_status(oid) in ("inline", "store", "error"):
                     continue
                 self._object_error(oid, err)
-            self._record_event(spec, "FAILED", direct=True,
+            self._record_event(spec, self._failure_state(err), direct=True,
                                error=self._err_summary(err))
 
     # --------------------------------------------------------------- cluster
@@ -2412,6 +2498,13 @@ class Raylet:
             self._handle_xdirect_done(msg)
         elif t == "xkill":
             self.kill_actor(msg["actor_id"], msg.get("no_restart", True))
+        elif t == "xcancel":
+            # one-hop cancel relay for forwarded/foreign-executed tasks
+            # (_relay=False: the origin already broadcast — no loops)
+            self._cancel_tid(msg["task_id"],
+                             deadline=msg.get("deadline", False),
+                             recursive=msg.get("recursive", True),
+                             _relay=False)
         elif t == "pull":
             self._handle_pull(peer, msg)
         elif t == "pull_meta":
@@ -3905,6 +3998,53 @@ class Raylet:
             # (the direct_done raced the channel teardown): already
             # resolved — never execute twice.
             return
+        self._note_child(spec)
+        flag = self._cancelled_flag(spec)
+        if flag is not None and spec.kind != ACTOR_CREATION_TASK:
+            # This task (or the parent that spawned it) was already reaped
+            # by a cancel/deadline fan-out — its submit frame raced the
+            # fan-out here.  Drop it at the door, and remember IT so its
+            # own late-arriving children are caught too.
+            self._note_cancelled(spec.task_id, flag)
+            if flag:
+                self._m_deadline_exceeded += 1
+                self._shed_spec(spec, DeadlineExceededError(
+                    f"task {spec.name} parent deadline already expired",
+                    hop="raylet.admission"), "EXPIRED", hop="admission")
+            else:
+                self._m_cancelled += 1
+                self._shed_spec(spec, TaskCancelledError(
+                    f"task {spec.name} was cancelled before it ran"),
+                    "CANCELLED")
+            return
+        if config.deadlines and spec.deadline is not None \
+                and spec.kind != ACTOR_CREATION_TASK:
+            # Admission control: an already-expired request is dropped at
+            # the door — no dep pinning, no lineage, no queue slot, no
+            # wasted exec (reference: Serve request timeouts shed before
+            # the replica sees the request).
+            remaining = spec.deadline - time.time()
+            if remaining <= 0:
+                self._m_deadline_exceeded += 1
+                err = DeadlineExceededError(
+                    f"task {spec.name} deadline expired before admission",
+                    hop="raylet.admission")
+                for oid in spec.return_ids():
+                    self._object_error(oid, err)
+                self._record_event(spec, "EXPIRED", hop="admission",
+                                   error=self._err_summary(err))
+                return
+            # Expiry timer: fires while the task is still queued anywhere
+            # on this node (waiting on args, ready queue, actor queue) —
+            # running tasks are interrupted by the worker-side watchdog,
+            # and a completed task makes this a no-op.  Captures ids
+            # only: a closure over the spec would pin its arg payloads
+            # in the timer heap for the whole deadline window even after
+            # the task completes.
+            self.add_timer(
+                remaining + 0.01,
+                lambda t=spec.task_id, o=spec.return_ids(), n=spec.name:
+                self._on_deadline(t, o, n))
         # Lineage for eviction recovery: NORMAL tasks only (actor results
         # aren't replayable) and bounded — beyond the cap new objects lose
         # reconstructability instead of the raylet growing without limit
@@ -4019,9 +4159,20 @@ class Raylet:
                 self._record_event(spec, "FAILED", direct=True,
                                    error=self._err_summary(err))
                 return
+            depth = config.max_queue_depth
+            if (depth > 0 and len(actor.queue) >= depth
+                    and self._shed_lowest_headroom(
+                        actor.queue, spec, "actor queue")):
+                return
             actor.queue.append(spec)
             self._pump_actor(actor)
         else:
+            depth = config.max_queue_depth
+            if (depth > 0 and spec.kind == NORMAL_TASK
+                    and len(self._ready_queue) >= depth
+                    and self._shed_lowest_headroom(
+                        self._ready_queue, spec, "ready queue")):
+                return
             self._ready_queue.append(spec)
 
     def _route_foreign_actor_task(self, spec: TaskSpec) -> bool:
@@ -4183,6 +4334,15 @@ class Raylet:
                 break
             spec = self._ready_queue.popleft()
             if self._dep_errored(spec):
+                continue
+            if self._deadline_expired(spec):
+                # pre-dispatch check: a task that expired while queued is
+                # dropped before it costs a worker (typed result, no exec)
+                self._m_deadline_exceeded += 1
+                self._shed_spec(spec, DeadlineExceededError(
+                    f"task {spec.name} deadline expired in the ready queue",
+                    hop="raylet.pre_dispatch"), "EXPIRED", hop="pre_dispatch")
+                self._cancel_children(spec.task_id, deadline=True)
                 continue
             if (not spec.placement and spec.kind == NORMAL_TASK
                     and not self.cluster_mode):
@@ -4619,6 +4779,13 @@ class Raylet:
             spec = actor.queue.popleft()
             if self._dep_errored(spec):
                 continue
+            if self._deadline_expired(spec):
+                self._m_deadline_exceeded += 1
+                self._shed_spec(spec, DeadlineExceededError(
+                    f"call {spec.name} deadline expired in the actor queue",
+                    hop="raylet.pre_dispatch"), "EXPIRED", hop="pre_dispatch")
+                self._cancel_children(spec.task_id, deadline=True)
+                continue
             if not group_has_room(spec):
                 deferred_groups.append(spec)
                 continue
@@ -4838,27 +5005,222 @@ class Raylet:
                 pass
         # death will be observed via socket EOF
 
-    def cancel_task(self, oid: ObjectID) -> bool:
-        """Best-effort cancel of a not-yet-running task (reference:
-        `CoreWorker::CancelTask`); running tasks are not interrupted."""
-        tid = oid.task_id()
+    # ---------------------------------------- overload / deadlines / cancel
+
+    def _failure_state(self, err) -> str:
+        """Task-event state for a worker-reported failure: deadline and
+        cancel interruptions enforced ON the worker still show up as
+        EXPIRED/CANCELLED events (and count) here, not as generic FAILED."""
+        if isinstance(err, DeadlineExceededError):
+            self._m_deadline_exceeded += 1
+            return "EXPIRED"
+        if isinstance(err, TaskCancelledError):
+            self._m_cancelled += 1
+            return "CANCELLED"
+        if isinstance(err, BackPressureError):
+            self._m_shed += 1
+            return "SHED"
+        return "FAILED"
+
+    def _note_child(self, spec: TaskSpec):
+        """Record the parent->child cancel fan-out edge (submits made
+        while a task ran, relayed or direct).  Bounded LRU on parents."""
+        parent = spec.parent_task_id
+        if parent is None:
+            return
+        kids = self._children.get(parent)
+        if kids is None:
+            kids = self._children[parent] = []
+            while len(self._children) > 4096:
+                self._children.popitem(last=False)
+        if len(kids) < 1024:  # runaway fan-out: stop indexing, not serving
+            kids.append(spec.task_id)
+
+    def _deadline_expired(self, spec: TaskSpec) -> bool:
+        return (config.deadlines and spec.deadline is not None
+                and time.time() > spec.deadline)
+
+    def _shed_spec(self, spec: TaskSpec, err: Exception, state: str,
+                   **extra):
+        """Terminal rejection of a queued/admitted task: error its
+        returns, release anything it pinned, record the task event (a
+        shed request still exports its errored span via _record_event)."""
+        for oid in spec.return_ids():
+            self._object_error(oid, err)
+        self._record_event(spec, state, error=self._err_summary(err),
+                           **extra)
+
+    def _shed_lowest_headroom(self, queue_, spec: TaskSpec, where: str):
+        """Bounded-queue admission (RAY_TPU_MAX_QUEUE_DEPTH): the queue is
+        full — shed the task with the LEAST deadline headroom (closest to
+        expiry: least likely to finish in time; no deadline = infinite
+        headroom), which is the new arrival only when nothing queued is
+        worse.  Returns True when the NEW spec was shed (caller must not
+        enqueue it)."""
+        now = time.time()
+
+        def headroom(s: TaskSpec) -> float:
+            return (s.deadline - now) if s.deadline is not None \
+                else float("inf")
+
+        victim = spec
+        if config.deadlines:
+            worst = min(queue_, key=headroom, default=None)
+            if worst is not None and headroom(worst) < headroom(victim):
+                try:
+                    queue_.remove(worst)
+                    victim = worst
+                except ValueError:  # raced away
+                    pass
+        self._m_shed += 1
+        self._shed_spec(victim, BackPressureError(
+            f"{where} at max_queue_depth={config.max_queue_depth}; "
+            f"task {victim.name} shed"), "SHED", where=where)
+        return victim is spec
+
+    def _on_deadline(self, tid: TaskID, return_oids, name: str):
+        """Deadline timer fired: reap the task wherever it still is.
+        Queued work is shed here with cancel fan-out to its children;
+        running work gets a deadline-flavored cancel frame (the worker's
+        own watchdog usually beat us to it — both are idempotent)."""
+        if not config.deadlines:
+            return
+        if all(self._object_status(o) in ("inline", "store", "error")
+               for o in return_oids):
+            return  # completed (or already errored) in time
+        err = DeadlineExceededError(
+            f"task {name} missed its deadline", hop="raylet.queue")
+        found = self._dequeue_tid(tid)
+        if found is not None:
+            self._m_deadline_exceeded += 1
+            self._shed_spec(found, err, "EXPIRED", hop="queue")
+            self._schedule()
+        else:
+            self._interrupt_running(tid, deadline=True)
+        # fan out regardless: downstream work inherited this deadline but
+        # its own timers may sit on other nodes' clocks — reap now
+        self._cancel_children(tid, deadline=True)
+
+    def _dequeue_tid(self, tid: TaskID) -> Optional[TaskSpec]:
+        """Remove a not-yet-running task from whichever queue holds it
+        (arg-wait, ready queue, or an actor call queue); returns its spec
+        or None."""
         entry = self._waiting.pop(tid, None)
-        found = entry is not None
         if entry is not None:
             spec, missing = entry
             for m in missing:
                 peers = self._dep_index.get(m)
                 if peers:
                     peers.discard(tid)
-        for spec in list(self._ready_queue):
+            return spec
+        for spec in self._ready_queue:
             if spec.task_id == tid:
                 self._ready_queue.remove(spec)
-                found = True
-        if found:
-            err = TaskError("cancelled", "task was cancelled before it ran",
-                            None)
-            self._object_error(oid, err)
-        return found
+                return spec
+        for actor in self._actors.values():
+            for spec in actor.queue:
+                if spec.task_id == tid:
+                    actor.queue.remove(spec)
+                    return spec
+        return None
+
+    def _interrupt_running(self, tid: TaskID, deadline: bool) -> bool:
+        """Ship a cancel frame to the worker executing ``tid`` (relayed
+        dispatch or a direct call we saw a RUNNING note for): its cancel
+        registry interrupts the executor thread and the ordinary done
+        path reports the typed error."""
+        rec = self._direct_running.get(tid)
+        conn = rec[0] if rec is not None else None
+        if conn is None:
+            for c in self._workers.values():
+                if tid in c.inflight:
+                    conn = c
+                    break
+        if conn is None:
+            return False
+        try:
+            conn.send({"t": "cancel", "task_id": tid, "deadline": deadline})
+        except OSError:
+            self._on_worker_death(conn)
+            return False
+        return True
+
+    def _cancel_children(self, tid: TaskID, deadline: bool = False,
+                         _depth: int = 0):
+        """Recursive cancel fan-out along recorded parent->child edges."""
+        if _depth > 64:
+            return
+        for child_tid in self._children.pop(tid, ()):
+            self._cancel_tid(child_tid, deadline=deadline,
+                             recursive=True, _depth=_depth + 1)
+
+    def _note_cancelled(self, tid: TaskID, deadline: bool):
+        """Remember a reaped task id so a child whose submit/running note
+        is still in flight gets caught at admission (bounded LRU)."""
+        self._cancelled_tids[tid] = deadline
+        while len(self._cancelled_tids) > 4096:
+            self._cancelled_tids.popitem(last=False)
+
+    def _cancelled_flag(self, spec: TaskSpec) -> Optional[bool]:
+        """Was this spec — or the parent it was spawned from — already
+        reaped by a cancel/deadline fan-out?  Returns the deadline flag
+        (False = plain cancel) or None."""
+        flag = self._cancelled_tids.get(spec.task_id)
+        if flag is None and spec.parent_task_id is not None:
+            flag = self._cancelled_tids.get(spec.parent_task_id)
+        return flag
+
+    def _cancel_tid(self, tid: TaskID, deadline: bool = False,
+                    recursive: bool = True, _depth: int = 0,
+                    _relay: bool = True) -> bool:
+        """Cancel one task by id wherever it is on this node; optionally
+        fan out to its children and relay to peer raylets (forwarded
+        tasks / foreign actor calls execute elsewhere)."""
+        self._note_cancelled(tid, deadline)
+        hit = False
+        spec = self._dequeue_tid(tid)
+        if spec is not None:
+            hit = True
+            if deadline:
+                self._m_deadline_exceeded += 1
+                self._shed_spec(spec, DeadlineExceededError(
+                    f"task {spec.name} missed its deadline",
+                    hop="raylet.queue"), "EXPIRED", hop="queue")
+            else:
+                self._m_cancelled += 1
+                self._shed_spec(spec, TaskCancelledError(
+                    f"task {spec.name} was cancelled before it ran"),
+                    "CANCELLED")
+            self._schedule()
+        elif self._interrupt_running(tid, deadline=deadline):
+            # counted when the worker reports the typed error (the done
+            # path routes through _failure_state) — counting here too
+            # would double every mid-exec cancel
+            hit = True
+        elif _relay and self.cluster_mode:
+            # not here: the task may have been forwarded / executed on
+            # a peer (foreign actor call, spillback) — one-hop relay
+            for peer in list(self._peers.values()):
+                try:
+                    peer.send({"t": "xcancel", "task_id": tid,
+                               "deadline": deadline,
+                               "recursive": recursive})
+                except OSError:
+                    self._drop_peer(peer)
+        if recursive:
+            self._cancel_children(tid, deadline=deadline, _depth=_depth)
+        return hit
+
+    def cancel_task(self, oid: ObjectID, force: bool = False,
+                    recursive: bool = True) -> bool:
+        """Cancel the task that produces ``oid`` (reference:
+        ``CoreWorker::CancelTask``): queued work is dropped with a typed
+        ``TaskCancelledError``, RUNNING work is interrupted in its
+        executor thread, and ``recursive=True`` fans the cancel out to
+        every task it spawned (``force`` currently behaves like a normal
+        cancel — the interrupt already stops execution)."""
+        return self._cancel_tid(oid.task_id(), deadline=False,
+                                recursive=recursive)
 
     # --------------------------------------------------------------- requests
 
@@ -4972,7 +5334,10 @@ class Raylet:
                 reply(value=self.reconstruct_object(
                     ObjectID.from_hex(msg["id"])))
             elif op == "cancel_task":
-                reply(value=self.cancel_task(ObjectID.from_hex(msg["id"])))
+                reply(value=self.cancel_task(
+                    ObjectID.from_hex(msg["id"]),
+                    force=msg.get("force", False),
+                    recursive=msg.get("recursive", True)))
             elif op == "available_resources":
                 reply(value=dict(self.resources_available))
             elif op == "cluster_resources":
@@ -5633,9 +5998,10 @@ class Raylet:
                 ev["trace_id"] = spec.trace_ctx["trace_id"]
                 self._trace_transition(spec, state, ev["time"],
                                        error=extra.get("error"))
-            elif state == "FAILED":
-                # head-sampled out, but errored requests always export
-                self._trace_hop(spec, "raylet.task_failed",
+            elif state in ("FAILED", "SHED", "EXPIRED", "CANCELLED"):
+                # head-sampled out, but errored requests always export —
+                # a shed/expired request still shows up as an ERROR span
+                self._trace_hop(spec, f"raylet.task_{state.lower()}",
                                 ev["time"], ev["time"], status="ERROR",
                                 error=extra.get("error"))
         self._task_events.append(ev)
@@ -5655,7 +6021,7 @@ class Raylet:
                 spec._queued_t = None
                 self._im["dispatch_latency"].observe(
                     time.monotonic() - queued_t)
-        elif state in ("FINISHED", "FAILED"):
+        elif state in ("FINISHED", "FAILED", "SHED", "EXPIRED", "CANCELLED"):
             self._m_tasks_done[state] += 1
         # ---- export to the GCS task-event table ----
         if not self._flag_task_events.value:
@@ -5837,6 +6203,18 @@ class Raylet:
                 "ray_tpu_internal_checkpoint_restores_total",
                 "Actor restarts that restored from a checkpoint instead "
                 "of starting cold"),
+            # ---- overload protection & deadlines ----
+            "shed": counter(
+                "ray_tpu_internal_shed_total",
+                "Requests rejected by overload protection (bounded-queue "
+                "admission, lowest-deadline-headroom victim policy)"),
+            "deadline_exceeded": counter(
+                "ray_tpu_internal_deadline_exceeded_total",
+                "Tasks whose end-to-end deadline expired (admission, "
+                "queue, or pre-dispatch enforcement on this node)"),
+            "cancelled": counter(
+                "ray_tpu_internal_cancelled_total",
+                "Tasks cancelled (explicit cancel + recursive fan-out)"),
             # ---- failure detection / fencing ----
             "fenced_frames": counter(
                 "ray_tpu_internal_fenced_frames_total",
@@ -5923,6 +6301,10 @@ class Raylet:
         bump(im["ckpt_bytes"], "ckpt_bytes", self._m_ckpt_bytes)
         bump(im["ckpt_restores"], "ckpt_restores", self._m_ckpt_restores)
         bump(im["fenced_frames"], "fenced_frames", self._m_fenced_frames)
+        bump(im["shed"], "shed", self._m_shed)
+        bump(im["deadline_exceeded"], "deadline_exceeded",
+             self._m_deadline_exceeded)
+        bump(im["cancelled"], "cancelled", self._m_cancelled)
         if self._pull_manager is not None:
             ps = self._pull_manager.stats()
             im["pull_inflight_bytes"].set(ps["inflight_bytes"])

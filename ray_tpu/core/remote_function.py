@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.core.config import config
@@ -49,6 +50,18 @@ def _placement_from_opts(opts) -> Optional[dict]:
     return None
 
 
+def deadline_from_opts(opts) -> Optional[float]:
+    """``deadline_s`` (relative seconds) -> absolute wall-clock deadline;
+    None when unset or the RAY_TPU_DEADLINES kill switch is off."""
+    ds = opts.get("deadline_s")
+    if ds is None or not config.deadlines:
+        return None
+    ds = float(ds)
+    if ds < 0:
+        raise ValueError("deadline_s must be >= 0")
+    return time.time() + ds
+
+
 class RemoteFunction:
     def __init__(self, function, **options):
         self._function = function
@@ -93,6 +106,7 @@ class RemoteFunction:
             replicate=bool(opts.get("_replicate", False)),
             runtime_env=_prepare_env(worker, opts.get("runtime_env")),
             placement=_placement_from_opts(opts),
+            deadline=deadline_from_opts(opts),
         )
         from ray_tpu.util.tracing import submit_with_span
 

@@ -97,6 +97,18 @@ class TaskSpec:
     # executing worker (reference: span context in task metadata,
     # `tracing_helper.py:289`)
     trace_ctx: Optional[dict] = None
+    # End-to-end request deadline: ABSOLUTE wall-clock time.time() at
+    # which this task (and everything it spawns — nested submits inherit
+    # the tightest enclosing deadline) must be done.  Rides the frame
+    # protocol, xtask forwarding and the direct transport like any other
+    # spec field; enforced at raylet admission, pre-dispatch, worker
+    # pre-exec and mid-exec (reference: Serve request_timeout_s +
+    # task cancellation).  None = no deadline.
+    deadline: Optional[float] = None
+    # TaskID of the task whose execution submitted this one (None for
+    # driver submissions): the raylet's cancel fan-out walks this edge so
+    # cancel(recursive=True) / deadline expiry reaps downstream work.
+    parent_task_id: Optional[TaskID] = None
 
     # Dynamic attributes (dataclass __dict__ pickles them with the spec):
     #   _direct_generation — actor restart generation stamped by the

@@ -226,12 +226,35 @@ class Worker:
     # ------------------------------------------------------------ core ops
 
     def submit_spec(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._stamp_lineage(spec)
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         d = self._direct
         if d is not None and d.try_submit(spec):
             return refs  # rode the direct channel (or its fallback)
         self._submit_relayed(spec)
         return refs
+
+    def _stamp_lineage(self, spec: TaskSpec):
+        """Deadline propagation + cancel fan-out edges: a spec submitted
+        FROM a running task inherits the tightest enclosing deadline and
+        records its parent task id, so deadline expiry / recursive cancel
+        reach nested work wherever it was spawned.  Actor CREATION never
+        inherits a deadline — the actor outlives the request that made it
+        (the raylet's admission path exempts creations for the same
+        reason; inheriting here would have the worker kill a creation the
+        raylet deliberately admitted)."""
+        from ray_tpu.core.task_spec import ACTOR_CREATION_TASK
+        from ray_tpu.runtime_context import _current_deadline, _current_task_id
+
+        parent = _current_task_id.get()
+        if parent is not None and spec.parent_task_id is None:
+            spec.parent_task_id = parent
+        if not config.deadlines or spec.kind == ACTOR_CREATION_TASK:
+            return
+        ambient = _current_deadline.get()
+        if ambient is not None and (spec.deadline is None
+                                    or ambient < spec.deadline):
+            spec.deadline = ambient
 
     def _submit_relayed(self, spec: TaskSpec):
         """The raylet-mediated submit path — also the direct transport's
@@ -553,12 +576,21 @@ class Worker:
         return self._request("stream_next", task_id=task_id, index=index,
                              _wait_timeout=timeout)
 
-    def cancel(self, ref) -> bool:
-        if self.mode == DRIVER:
-            return self.raylet.call(self.raylet.cancel_task, ref.id()).result()
+    def cancel(self, ref, force: bool = False, recursive: bool = True) -> bool:
         if self.mode == LOCAL:
             return False
-        return self._request("cancel_task", id=ref.hex())
+        hit = False
+        if self._direct is not None:
+            # the call may be in flight on a direct channel the raylet
+            # never saw dispatch: the cancel frame must reach the dialed
+            # callee's in-flight registry, not just the raylet queues
+            hit = self._direct.cancel(ref.id())
+        if self.mode == DRIVER:
+            return bool(self.raylet.call(
+                self.raylet.cancel_task, ref.id(), force,
+                recursive).result()) or hit
+        return bool(self._request("cancel_task", id=ref.hex(), force=force,
+                                  recursive=recursive)) or hit
 
     def gcs_nodes(self) -> List[dict]:
         if self.mode == DRIVER:

@@ -27,7 +27,12 @@ import cloudpickle
 
 from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import config
-from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.exceptions import (
+    BackPressureError,
+    DeadlineExceededError,
+    TaskCancelledError,
+    TaskError,
+)
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.task_spec import (
@@ -38,6 +43,156 @@ from ray_tpu.core.task_spec import (
 )
 from ray_tpu.core.worker import WORKER, Worker, init_worker
 from ray_tpu.util.locks import make_lock
+
+#: control-flow errors that must reach the caller TYPED (not wrapped in
+#: TaskError) and are never retried — backpressure rejections, deadline
+#: expiry, cancellation
+CONTROL_ERRORS = (BackPressureError, DeadlineExceededError,
+                  TaskCancelledError)
+
+
+def _async_raise(thread_ident: int, exc_type) -> bool:
+    """Raise ``exc_type`` asynchronously in another thread (delivered at
+    its next bytecode boundary) — the CPython seam behind mid-exec
+    cancellation/deadlines, same mechanism the reference uses for
+    non-force task cancellation (KeyboardInterrupt into the executor)."""
+    import ctypes
+
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_type))
+    if res > 1:  # pragma: no cover — defensive: undo a multi-target hit
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident), None)
+        return False
+    return res == 1
+
+
+class _CancelRegistry:
+    """Per-process cancellation + deadline enforcement for executing
+    tasks.  One watchdog thread (lazy) arms every deadline; cancel frames
+    (raylet ``cancel`` / direct ``dcancel``) interrupt the registered
+    executor thread or mark a not-yet-started task for the pre-exec
+    check.  Sync executions register their thread ident; asyncio actor
+    calls register with ident None (cooperative pre-exec check only — an
+    async exception into the shared loop thread would kill the loop)."""
+
+    def __init__(self):
+        self._lock = make_lock("worker.cancel_registry")
+        self._cancelled: dict = {}  # task_id -> exc type  # guard: _lock
+        self._running: dict = {}  # task_id -> thread ident or None  # guard: _lock
+        self._interrupted: set = set()  # tids already async-raised  # guard: _lock
+        self._deadlines: list = []  # heap[(deadline, task_id)]  # guard: _lock
+        self._wake = threading.Condition(self._lock)
+        self._watchdog_started = False  # guard: _lock
+
+    # ---- cancel frames (reader / direct-conn threads) ----
+
+    def cancel(self, task_id, exc_type=TaskCancelledError):
+        """Mark cancelled; interrupt now if the task is mid-exec.  The
+        async raise happens UNDER the lock (deregister serializes behind
+        it, so the exception can never land on a thread that already
+        moved on to the next task) and at most ONCE per task id — the
+        same cancel arriving on two paths (dcancel + raylet frame, or
+        cancel racing the deadline watchdog) must not deliver a second
+        exception into the except-handler that is reporting the first
+        (the aborted done frame would hang the caller forever)."""
+        with self._lock:
+            self._cancelled[task_id] = exc_type
+            while len(self._cancelled) > 4096:  # bounded: stale ids age out
+                self._cancelled.pop(next(iter(self._cancelled)))
+            entry = self._running.get(task_id)
+            if entry is not None and task_id not in self._interrupted:
+                self._interrupted.add(task_id)
+                self._interrupt(entry, exc_type)
+
+    @staticmethod
+    def _interrupt(entry, exc_type):
+        """Deliver the interrupt for a registry entry: thread ident ->
+        async exception at the next bytecode; asyncio record ->
+        task.cancel() scheduled on the loop (raises CancelledError at
+        the coroutine's next await — an async exception into the shared
+        loop thread would kill every interleaved call)."""
+        if isinstance(entry, tuple):
+            loop, atask = entry[1], entry[2]
+            loop.call_soon_threadsafe(atask.cancel)
+        else:
+            _async_raise(entry, exc_type)
+
+    def check(self, task_id):
+        """Pre-exec seam: raise if this task was cancelled before it ran."""
+        with self._lock:
+            exc = self._cancelled.get(task_id)
+        if exc is not None:
+            raise exc()
+
+    def cancelled_as(self, task_id):
+        """The typed error this task was cancelled with (None if it
+        wasn't) — lets the asyncio path convert a CancelledError back
+        into the control error the caller dispatches on."""
+        with self._lock:
+            return self._cancelled.get(task_id)
+
+    # ---- execution registration ----
+
+    def register(self, task_id, ident, deadline):
+        with self._lock:
+            exc = self._cancelled.get(task_id)
+            if exc is not None:
+                # cancel frame landed between the pre-exec check and
+                # registration: raise HERE (we are on the executor
+                # thread) instead of executing uninterruptible
+                raise exc()
+            self._running[task_id] = ident
+            if deadline is not None and ident is not None \
+                    and config.deadlines:
+                self._arm_deadline(task_id, deadline)
+
+    def register_async(self, task_id, loop, atask, deadline):
+        """Asyncio actor call: interruptible via task.cancel() on the
+        loop (CancelledError at the next await).  Raises like register()
+        when a cancel already landed."""
+        with self._lock:
+            exc = self._cancelled.get(task_id)
+            if exc is not None:
+                raise exc()
+            self._running[task_id] = ("async", loop, atask)
+            if deadline is not None and config.deadlines:
+                self._arm_deadline(task_id, deadline)
+
+    def _arm_deadline(self, task_id, deadline):  # requires: _lock
+        import heapq
+
+        heapq.heappush(self._deadlines, (deadline, task_id))
+        if not self._watchdog_started:
+            self._watchdog_started = True
+            threading.Thread(target=self._watchdog_loop,
+                             name="deadline-watchdog",
+                             daemon=True).start()
+        self._wake.notify()
+
+    def deregister(self, task_id):
+        with self._lock:
+            self._running.pop(task_id, None)
+            self._cancelled.pop(task_id, None)
+            self._interrupted.discard(task_id)
+
+    def _watchdog_loop(self):
+        import heapq
+
+        while True:
+            with self._lock:
+                now = time.time()
+                while self._deadlines and self._deadlines[0][0] <= now:
+                    _, task_id = heapq.heappop(self._deadlines)
+                    entry = self._running.get(task_id)
+                    if entry is not None \
+                            and task_id not in self._interrupted:
+                        self._cancelled[task_id] = DeadlineExceededError
+                        self._interrupted.add(task_id)
+                        self._interrupt(entry, DeadlineExceededError)
+                timeout = (self._deadlines[0][0] - now
+                           if self._deadlines else None)
+                self._wake.wait(timeout)
 
 
 class RemoteWorker(Worker):
@@ -72,6 +227,10 @@ class RemoteWorker(Worker):
         # conn thread executing inline (plain sync actors / leased pool
         # workers) — single-threaded execution semantics hold either way.
         self.exec_lock = make_lock("worker.exec")
+        # Cancellation + deadline enforcement for tasks executing here
+        # (cancel frames from the raylet, dcancel from direct callers,
+        # and the deadline watchdog all funnel through it).
+        self.cancel_registry = _CancelRegistry()
         self._rid = 0  # guard: _rid_lock
         self._rid_lock = make_lock("remote_worker.rid")
         self._pending: Dict[int, dict] = {}
@@ -140,6 +299,16 @@ class RemoteWorker(Worker):
                                     proc="worker")})
                 except OSError:
                     pass
+            elif t == "cancel":
+                # cancel/deadline fan-out from the raylet: a queued task
+                # is marked for the pre-exec check, a RUNNING one gets
+                # the exception raised in its executor thread (handled
+                # HERE on the reader thread — the executor is the thread
+                # being interrupted)
+                self.cancel_registry.cancel(
+                    msg["task_id"],
+                    DeadlineExceededError if msg.get("deadline")
+                    else TaskCancelledError)
             elif t == "direct_lease":
                 # lease grant/release notice: the DirectServer validates
                 # lease hellos against this token (None = not leased)
@@ -416,6 +585,32 @@ def _apply_runtime_env(spec: TaskSpec):
     _rtenv.ensure_runtime_env(global_worker(), spec.runtime_env)
 
 
+def _enrich_control_error(e, spec: TaskSpec):
+    """Async-raised interrupts come from PyThreadState_SetAsyncExc with
+    the exception CLASS (instances are unreliable there), so a mid-exec
+    DeadlineExceededError carries no message/hop — rebuild it with the
+    task name and the worker.mid_exec hop before it rides to the
+    caller."""
+    if isinstance(e, DeadlineExceededError) and not e.hop:
+        return DeadlineExceededError(
+            f"task {spec.name} missed its deadline mid-execution",
+            hop="worker.mid_exec")
+    return e
+
+
+def _preflight(worker: RemoteWorker, spec: TaskSpec):
+    """Deadline + cancellation gate, run before any expensive phase
+    (entry, between arg-pull and exec): work whose deadline already
+    passed — or that a cancel frame reached first — raises the typed
+    control error instead of executing (no wasted exec)."""
+    worker.cancel_registry.check(spec.task_id)
+    if (config.deadlines and spec.deadline is not None
+            and time.time() > spec.deadline):
+        raise DeadlineExceededError(
+            f"task {spec.name} deadline expired before execution",
+            hop="worker.pre_exec")
+
+
 def _setup_actor_concurrency(worker: RemoteWorker, spec: TaskSpec):
     """After actor instantiation: start the thread pool / asyncio loop that
     back max_concurrency>1 and coroutine methods."""
@@ -518,10 +713,15 @@ async def _execute_async(worker: RemoteWorker, msg: dict):
 
 async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
     spec: TaskSpec = msg["spec"]
-    from ray_tpu.runtime_context import _current_task_id
+    from ray_tpu.runtime_context import (
+        _current_deadline,
+        _current_task_id,
+    )
     from ray_tpu.util import profiling, tracing
 
     _ctx_token = _current_task_id.set(spec.task_id)
+    _dl_token = _current_deadline.set(
+        spec.deadline if config.deadlines else None)
     # Profiler attribution (best-effort on the shared asyncio thread:
     # interleaved calls each stamp the loop thread while they hold it;
     # chain=False so an out-of-LIFO-order exit clears instead of
@@ -535,10 +735,31 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
         with tracing.maybe_span("worker.get_args"):
             args, kwargs = _resolve_args(worker, spec,
                                          msg.get("arg_values", {}))
-        with tracing.maybe_span("worker.exec"):
-            result = await getattr(worker.actor_instance, spec.method_name)(
-                *args, **kwargs
-            )
+        # Async calls: pre-exec check, then register the asyncio task so
+        # mid-exec cancel/deadline can task.cancel() it on the loop
+        # (CancelledError at the next await — an async exception into
+        # the shared loop thread would kill every interleaved call).
+        _preflight(worker, spec)
+        from ray_tpu.util import chaos as _chaos
+
+        _chaos.exec_delay(spec.name)
+        _preflight(worker, spec)
+        worker.cancel_registry.register_async(
+            spec.task_id, asyncio.get_running_loop(),
+            asyncio.current_task(),
+            spec.deadline if config.deadlines else None)
+        try:
+            with tracing.maybe_span("worker.exec"):
+                result = await getattr(
+                    worker.actor_instance, spec.method_name)(*args, **kwargs)
+        except asyncio.CancelledError:
+            # our cancel()/watchdog cancelled the task: convert back to
+            # the typed control error the caller dispatches on (the
+            # outer handler delivers it as the done frame)
+            exc = worker.cancel_registry.cancelled_as(spec.task_id)
+            raise (exc or TaskCancelledError)() from None
+        finally:
+            worker.cancel_registry.deregister(spec.task_id)
         with tracing.maybe_span("worker.result_push"):
             inline, stored, sizes, contains = _package_results(worker, spec,
                                                                result)
@@ -547,6 +768,14 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
                              "ok": True, "inline": inline, "stored": stored,
                              "sizes": sizes, "contains": contains})
         return True
+    except CONTROL_ERRORS as e:
+        # typed control-flow errors reach the caller AS-IS (a TaskError
+        # wrapper would hide the type the router/get() dispatch on)
+        _deliver_result(worker, msg, {
+            "t": "done", "task_id": spec.task_id, "ok": False,
+            "error": _enrich_control_error(e, spec), "retryable": False,
+        })
+        return False
     except Exception:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
@@ -557,6 +786,7 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
         return False
     finally:
         profiling.reset_task_tags(_ptags)
+        _current_deadline.reset(_dl_token)
         _current_task_id.reset(_ctx_token)
 
 
@@ -578,10 +808,15 @@ def execute_task(worker: RemoteWorker, msg: dict):
 
 def _execute_task_inner(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
-    from ray_tpu.runtime_context import _current_task_id
+    from ray_tpu.runtime_context import (
+        _current_deadline,
+        _current_task_id,
+    )
     from ray_tpu.util import profiling
 
     _ctx_token = _current_task_id.set(spec.task_id)
+    _dl_token = _current_deadline.set(
+        spec.deadline if config.deadlines else None)
     # Profiler attribution: samples taken on this thread while the task
     # runs fold under its task/trace/actor ids (flamegraph slicing).
     _ptags = profiling.set_task_tags(
@@ -590,6 +825,7 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         actor_id=spec.actor_id.hex() if spec.actor_id else None,
         name=spec.name)
     extra: dict = {}
+    _registered = False
     try:
         if msg.get("__bad_group__") is not None:
             raise ValueError(
@@ -598,9 +834,22 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         _apply_runtime_env(spec)
         from ray_tpu.util import tracing
 
+        _preflight(worker, spec)
         with tracing.maybe_span("worker.get_args"):
             args, kwargs = _resolve_args(worker, spec,
                                          msg.get("arg_values", {}))
+        # between arg-pull and exec: the deadline/cancel gate, then the
+        # chaos slow-executor seam, then gate again — an injected delay
+        # must be visible to the deadline check like real slowness
+        _preflight(worker, spec)
+        from ray_tpu.util import chaos as _chaos
+
+        _chaos.exec_delay(spec.name)
+        _preflight(worker, spec)
+        worker.cancel_registry.register(
+            spec.task_id, threading.get_ident(),
+            spec.deadline if config.deadlines else None)
+        _registered = True
         with tracing.maybe_span("worker.exec"):
             if spec.kind == ACTOR_CREATION_TASK:
                 cls = _resolve_callable(worker, spec, msg.get("fn_blob"))
@@ -667,6 +916,10 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
                 result = fn(*args, **kwargs)
             if spec.num_returns == STREAMING_RETURNS:
                 result = _run_streaming(worker, spec, result)
+        # out of the interruptible window BEFORE packaging results: a
+        # deadline/cancel exception landing mid-push could double-report
+        worker.cancel_registry.deregister(spec.task_id)
+        _registered = False
         with tracing.maybe_span("worker.result_push"):
             inline, stored, sizes, contains = _package_results(worker, spec,
                                                                result)
@@ -675,6 +928,15 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
                              "ok": True, "inline": inline, "stored": stored,
                              "sizes": sizes, "contains": contains, **extra})
         return True
+    except CONTROL_ERRORS as e:
+        # deadline expiry / cancellation / backpressure reach the caller
+        # TYPED (a TaskError wrapper would hide what get() dispatches on)
+        # and never retry
+        _deliver_result(worker, msg, {
+            "t": "done", "task_id": spec.task_id, "ok": False,
+            "error": _enrich_control_error(e, spec), "retryable": False,
+        })
+        return False
     except Exception as e:  # noqa: BLE001
         tb = traceback.format_exc()
         err = TaskError(spec.name, tb, None)
@@ -684,7 +946,17 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         })
         return False
     finally:
+        if _registered:
+            try:
+                worker.cancel_registry.deregister(spec.task_id)
+            except CONTROL_ERRORS:
+                # a cancel frame raced the error path's own deregister:
+                # the async exception fired while we were already
+                # unwinding (done frame sent) — absorb it here so it
+                # cannot escape into the executor / direct-conn loop
+                pass
         profiling.reset_task_tags(_ptags)
+        _current_deadline.reset(_dl_token)
         _current_task_id.reset(_ctx_token)
 
 
@@ -792,75 +1064,85 @@ def main():
             {"t": "profile_samples", "samples": samples,
              "dropped": dropped}))
     while True:
-        msg = worker.task_queue.get()
-        if msg.get("t") == "exit_checkpoint":
-            # restart-allowed kill: final snapshot (queued calls ahead of
-            # this message already ran and are counted in it), then exit —
-            # the raylet restarts the actor from this exact state.
-            if worker.checkpoint_interval:
-                _save_checkpoint(worker)
-            worker.flush_dones()
-            os._exit(0)
-        spec: TaskSpec = msg["spec"]
-        if (worker.direct_server is not None
-                and msg.get("direct_conn") is None):
-            cached, deferred = worker.direct_server.reconcile_probe(
-                spec.task_id)
-            if cached is not None:
-                # raylet-path reconcile of a direct call that ALREADY
-                # executed here: re-send the recorded result — executing
-                # again would double the call's side effects
-                cached["t"] = "done"
-                cached["task_id"] = spec.task_id
-                worker.send_done(cached)
-                continue
-            if deferred:
-                # the ORIGINAL direct execution is still in flight (e.g.
-                # a false-SUSPECT fence made the caller reconcile while
-                # the callee kept running): remember() answers this
-                # dispatch with the recorded result at completion —
-                # executing now would double the call's side effects
-                continue
-        if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
-                and spec.method_name != "__ray_terminate__"):
-            # getattr_static on the INSTANCE: side-effect-free (no property
-            # getters run on the dispatch thread — the hazard
-            # _setup_actor_concurrency documents) AND it sees instance-dict
-            # methods (self.handler = some_async_fn) that a type()-level
-            # lookup would miss, silently demoting them to the blocking
-            # sync path.  Static lookup returns raw descriptors, so unwrap
-            # them or an async staticmethod would fail the coroutine check.
-            method = inspect.getattr_static(
-                worker.actor_instance, spec.method_name, None)
-            if isinstance(method, (staticmethod, classmethod)):
-                method = method.__func__
-            if worker.actor_loop is not None and \
-                    inspect.iscoroutinefunction(method):
-                # Async actor: schedule on the loop, keep draining the queue
-                # — calls interleave at await points (up to max_concurrency
-                # in flight, bounded raylet-side).
-                asyncio.run_coroutine_threadsafe(
-                    _execute_async(worker, msg), worker.actor_loop
-                )
-                continue
-            if worker.group_executors is not None:
-                group = spec.concurrency_group
-                if group is None and method is not None:
-                    group = getattr(method, "__ray_tpu_method_options__",
-                                    {}).get("concurrency_group")
-                pool = worker.group_executors.get(group or "_default")
-                if pool is None:
-                    # undeclared group name: fail the CALL loudly (typos
-                    # must not silently serialize onto the default pool)
-                    msg["__bad_group__"] = group
-                    pool = worker.group_executors["_default"]
-                pool.submit(execute_task, worker, msg)
-                continue
-            if worker.actor_executor is not None:
-                worker.actor_executor.submit(execute_task, worker, msg)
-                continue
-        with worker.exec_lock:
-            execute_task(worker, msg)
+        try:
+            _main_tick(worker)
+        except CONTROL_ERRORS:
+            # a mid-exec cancel/deadline exception that lost the race with
+            # task completion lands here, between tasks — absorb it; the
+            # task it was aimed at already reported
+            continue
+
+
+def _main_tick(worker: RemoteWorker):
+    msg = worker.task_queue.get()
+    if msg.get("t") == "exit_checkpoint":
+        # restart-allowed kill: final snapshot (queued calls ahead of
+        # this message already ran and are counted in it), then exit —
+        # the raylet restarts the actor from this exact state.
+        if worker.checkpoint_interval:
+            _save_checkpoint(worker)
+        worker.flush_dones()
+        os._exit(0)
+    spec: TaskSpec = msg["spec"]
+    if (worker.direct_server is not None
+            and msg.get("direct_conn") is None):
+        cached, deferred = worker.direct_server.reconcile_probe(
+            spec.task_id)
+        if cached is not None:
+            # raylet-path reconcile of a direct call that ALREADY
+            # executed here: re-send the recorded result — executing
+            # again would double the call's side effects
+            cached["t"] = "done"
+            cached["task_id"] = spec.task_id
+            worker.send_done(cached)
+            return
+        if deferred:
+            # the ORIGINAL direct execution is still in flight (e.g.
+            # a false-SUSPECT fence made the caller reconcile while
+            # the callee kept running): remember() answers this
+            # dispatch with the recorded result at completion —
+            # executing now would double the call's side effects
+            return
+    if (spec.kind == ACTOR_TASK and worker.actor_instance is not None
+            and spec.method_name != "__ray_terminate__"):
+        # getattr_static on the INSTANCE: side-effect-free (no property
+        # getters run on the dispatch thread — the hazard
+        # _setup_actor_concurrency documents) AND it sees instance-dict
+        # methods (self.handler = some_async_fn) that a type()-level
+        # lookup would miss, silently demoting them to the blocking
+        # sync path.  Static lookup returns raw descriptors, so unwrap
+        # them or an async staticmethod would fail the coroutine check.
+        method = inspect.getattr_static(
+            worker.actor_instance, spec.method_name, None)
+        if isinstance(method, (staticmethod, classmethod)):
+            method = method.__func__
+        if worker.actor_loop is not None and \
+                inspect.iscoroutinefunction(method):
+            # Async actor: schedule on the loop, keep draining the queue
+            # — calls interleave at await points (up to max_concurrency
+            # in flight, bounded raylet-side).
+            asyncio.run_coroutine_threadsafe(
+                _execute_async(worker, msg), worker.actor_loop
+            )
+            return
+        if worker.group_executors is not None:
+            group = spec.concurrency_group
+            if group is None and method is not None:
+                group = getattr(method, "__ray_tpu_method_options__",
+                                {}).get("concurrency_group")
+            pool = worker.group_executors.get(group or "_default")
+            if pool is None:
+                # undeclared group name: fail the CALL loudly (typos
+                # must not silently serialize onto the default pool)
+                msg["__bad_group__"] = group
+                pool = worker.group_executors["_default"]
+            pool.submit(execute_task, worker, msg)
+            return
+        if worker.actor_executor is not None:
+            worker.actor_executor.submit(execute_task, worker, msg)
+            return
+    with worker.exec_lock:
+        execute_task(worker, msg)
 
 
 if __name__ == "__main__":

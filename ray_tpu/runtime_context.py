@@ -15,6 +15,12 @@ __all__ = ["RuntimeContext", "get_runtime_context"]
 _current_task_id: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_current_task_id", default=None)
 
+#: absolute deadline (time.time()) of the currently executing task, set by
+#: the worker's execute paths — nested submits inherit the tightest
+#: enclosing deadline through it (deadline propagation)
+_current_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_current_deadline", default=None)
+
 
 class RuntimeContext:
     def get_node_id(self) -> Optional[str]:

@@ -71,6 +71,12 @@ class Deployment:
     name: str
     num_replicas: int = 1
     max_ongoing_requests: int = 100
+    # End-to-end request deadline (reference: Serve request_timeout_s):
+    # stamped as an absolute deadline on every replica call this
+    # deployment serves — expiry anywhere in the pipeline sheds the work
+    # and raises DeadlineExceededError at the caller (HTTP 504 on the
+    # proxy).  None = no deadline.
+    request_timeout_s: Optional[float] = None
     user_config: Optional[dict] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Optional[dict] = None
@@ -99,6 +105,7 @@ class Application:
 
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
+               request_timeout_s: Optional[float] = None,
                user_config: Optional[dict] = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                ray_actor_options: Optional[dict] = None):
@@ -114,6 +121,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             name=name or getattr(obj, "__name__", "deployment"),
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            request_timeout_s=request_timeout_s,
             user_config=user_config,
             autoscaling_config=ac,
             ray_actor_options=ray_actor_options,
@@ -203,6 +211,7 @@ def _collect_specs(app: Application, route_prefix: str,
         "init_kwargs": init_kwargs,
         "num_replicas": dep.num_replicas,
         "max_ongoing_requests": dep.max_ongoing_requests,
+        "request_timeout_s": dep.request_timeout_s,
         "user_config": dep.user_config,
         "autoscaling_config": ac.to_dict() if ac else None,
         "ray_actor_options": dep.ray_actor_options,

@@ -157,6 +157,8 @@ class ServeController:
                         "replicas": self._serving_replica_names(st),
                         "max_ongoing_requests":
                             st.spec.get("max_ongoing_requests", 100),
+                        "request_timeout_s":
+                            st.spec.get("request_timeout_s"),
                     }
                     for name, st in self._deployments.items()
                 },
@@ -326,6 +328,9 @@ class ServeController:
                         spec["deployment_def"], spec.get("init_args") or (),
                         spec.get("init_kwargs") or {},
                         spec.get("user_config"),
+                        # the replica enforces this by REJECTING beyond it
+                        # (typed BackPressureError; router retries/sheds)
+                        spec.get("max_ongoing_requests", 100),
                     )
                     r = _ReplicaState(actor_name, handle, uid)
                     r.ready_ref = handle.check_health.remote()

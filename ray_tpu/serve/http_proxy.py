@@ -43,6 +43,11 @@ class HTTPProxy:
             def _dispatch(self, body: Optional[bytes]):
                 from urllib.parse import parse_qs
 
+                from ray_tpu.core.exceptions import (
+                    BackPressureError,
+                    DeadlineExceededError,
+                )
+
                 query = (self.path.split("?", 1) + [""])[1]
                 # model id: header (reference contract) or query param
                 model_id = self.headers.get(
@@ -50,13 +55,27 @@ class HTTPProxy:
                     parse_qs(query).get("model_id", [""])[0])
                 if parse_qs(query).get("stream", ["0"])[0] == "1":
                     return self._dispatch_stream(body, model_id)
+                retry_after = None
                 try:
                     status, payload = proxy._handle(self.path, body, model_id)
+                except BackPressureError as e:
+                    # graceful degradation: every replica rejected through
+                    # the router's retry budget — shed with 503 and tell
+                    # the client when to come back (reference: Serve
+                    # overload 503s instead of queueing to death)
+                    status, payload = 503, json.dumps(
+                        {"error": str(e), "retry_after_s": 1}).encode()
+                    retry_after = "1"
+                except DeadlineExceededError as e:
+                    status, payload = 504, json.dumps(
+                        {"error": str(e)}).encode()
                 except Exception as e:  # noqa: BLE001
                     status, payload = 500, json.dumps(
                         {"error": str(e)}).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                if retry_after is not None:
+                    self.send_header("Retry-After", retry_after)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -113,8 +132,6 @@ class HTTPProxy:
 
     def _handle(self, path: str, body: Optional[bytes],
                 model_id: str = ""):
-        import ray_tpu
-
         path = path.split("?", 1)[0]
         if path == "/-/healthz":
             return 200, b'"ok"'
@@ -129,7 +146,11 @@ class HTTPProxy:
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
         request = json.loads(body) if body else None
-        result = ray_tpu.get(handle.remote(request), timeout=120)
+        # call() = submit + resolve with replica-reject retries; a
+        # saturated deployment raises BackPressureError (mapped to 503 +
+        # Retry-After by the dispatcher), an expired request_timeout_s
+        # raises DeadlineExceededError (504)
+        result = handle.call(request, timeout=120)
         return 200, json.dumps(result, default=str).encode()
 
     def _match_route(self, path: str):

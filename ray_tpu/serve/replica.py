@@ -13,15 +13,27 @@ import threading
 import time
 from typing import Any, Optional
 
+from ray_tpu.core.config import config
+
+config.define("serve_backpressure", bool, True,
+              "Serve overload protection: replicas REJECT requests beyond "
+              "max_ongoing_requests with a typed BackPressureError "
+              "(router retries another replica, proxy sheds 503) instead "
+              "of queueing without bound.  0 restores silent queueing.")
+
 
 class Replica:
     def __init__(self, deployment_def, init_args, init_kwargs,
-                 user_config: Optional[dict] = None):
+                 user_config: Optional[dict] = None,
+                 max_ongoing_requests: int = 0):
         import cloudpickle
 
         fn_or_class = cloudpickle.loads(deployment_def)
         self._ongoing = 0
         self._total = 0
+        self._rejected = 0
+        # 0 = unenforced (legacy replicas / tests constructing directly)
+        self._max_ongoing = int(max_ongoing_requests or 0)
         self._lock = threading.Lock()
         self._start_time = time.time()
         if isinstance(fn_or_class, type):
@@ -33,19 +45,47 @@ class Replica:
 
     # ------------------------------------------------------------- serving
 
+    def _admit(self):
+        """max_ongoing_requests admission: REJECT (typed, retryable by the
+        router) instead of silently queueing — bounded work is what keeps
+        p99 finite under overload (reference: Serve max_ongoing_requests
+        backpressure)."""
+        from ray_tpu.core.exceptions import BackPressureError
+
+        with self._lock:
+            if (self._max_ongoing > 0 and config.serve_backpressure
+                    and self._ongoing >= self._max_ongoing):
+                self._rejected += 1
+                raise BackPressureError(
+                    f"replica at max_ongoing_requests="
+                    f"{self._max_ongoing} ({self._ongoing} in flight)")
+            self._ongoing += 1
+            self._total += 1
+
+    def _chaos_user_call(self):
+        """Slow-executor chaos seam INSIDE the admission-counted window
+        (the worker-level pre-exec seam sleeps before ``_admit`` runs, so
+        it can't pile up ``_ongoing``): matches
+        ``RAY_TPU_CHAOS_EXEC_DELAY_NAMES`` substring 'Replica.user' or the
+        user callable's own name."""
+        from ray_tpu.util import chaos
+
+        name = getattr(self._callable, "__name__",
+                       type(self._callable).__name__)
+        chaos.exec_delay(f"Replica.user:{name}")
+
     def handle_request(self, request: Any, method: str = "__call__",
                        multiplexed_model_id: str = ""):
         from ray_tpu.serve.multiplex import _set_model_id
 
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        self._admit()
         token = _set_model_id(multiplexed_model_id)
         try:
             if method == "__call__" and callable(self._callable):
                 fn = self._callable  # plain function or __call__ instance
             else:
                 fn = getattr(self._callable, method)
+            self._chaos_user_call()
             return fn(request)
         finally:
             from ray_tpu.serve.multiplex import _model_id_ctx
@@ -63,15 +103,14 @@ class Replica:
         from ray_tpu.serve.multiplex import _model_id_ctx, _set_model_id
         from ray_tpu.util import tracing
 
-        with self._lock:
-            self._ongoing += 1
-            self._total += 1
+        self._admit()
         token = _set_model_id(multiplexed_model_id)
         try:
             if method == "__call__" and callable(self._callable):
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method)
+            self._chaos_user_call()
             # time-to-first-token: the interval from request entry to the
             # first streamed item, emitted as a sub-span of this call's
             # task.run (the generator body runs inside its context)
@@ -110,6 +149,8 @@ class Replica:
 
     def stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total,
+                "rejected": self._rejected,
+                "max_ongoing_requests": self._max_ongoing,
                 "uptime_s": time.time() - self._start_time}
 
     def reconfigure(self, user_config: dict):

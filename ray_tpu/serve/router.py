@@ -15,8 +15,9 @@ import random
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
+import ray_tpu.serve.replica  # noqa: F401 — defines serve_backpressure
 from ray_tpu.core.config import config
 from ray_tpu.serve.controller import CONTROLLER_NAME, NAMESPACE
 
@@ -26,6 +27,12 @@ config.define("serve_probe_timeout_s", float, 1.0,
               "request that sampled it for the full window; with "
               "suspicion-based liveness a short probe plus immediate "
               "local exclusion re-picks in about a second worst-case.")
+config.define("serve_reject_retry_budget", int, 3,
+              "Per-request retry budget when a replica rejects with "
+              "BackPressureError (max_ongoing_requests admission): the "
+              "router re-picks another replica up to this many times "
+              "(jittered backoff between attempts) before shedding the "
+              "request — HTTP 503 + Retry-After on the proxy.")
 
 
 class _DeploymentRouting:
@@ -40,6 +47,14 @@ class _DeploymentRouting:
         self.replicas: List[Any] = []
         self.fetched = False
         self.version = -1
+        self.request_timeout_s: Optional[float] = None
+        self.max_ongoing = 0  # guard: lock
+        # Router-side in-flight count per replica (reference: the Serve
+        # router tracks its own per-replica in-flight and never
+        # over-dispatches): ``call()`` claims a slot BEFORE submitting,
+        # so an overloaded deployment rejects at the router in
+        # microseconds instead of the request queueing replica-side.
+        self.inflight: Dict[Any, int] = {}  # guard: lock
         self._listener: Optional[threading.Thread] = None
 
     def _controller(self):
@@ -59,6 +74,8 @@ class _DeploymentRouting:
             self.replicas = handles
             self.fetched = True
             self.version = routing["version"]
+            self.request_timeout_s = entry.get("request_timeout_s")
+            self.max_ongoing = int(entry.get("max_ongoing_requests") or 0)
 
     def refresh(self, force: bool = False):
         import ray_tpu
@@ -350,32 +367,131 @@ class DeploymentHandle:
                 _model_affinity.popitem(last=False)
         return replica
 
-    def remote(self, request: Any = None):
+    def remote(self, request: Any = None, _replica: Any = None):
         """Dispatch; returns an ObjectRef (resolve with ray_tpu.get), or an
         ObjectRefGenerator when the handle has ``stream=True``."""
         from ray_tpu.util import tracing
 
         if not tracing.tracing_enabled():
-            return self._remote_inner(request)
+            return self._remote_inner(request, _replica)
         # router→replica hop: the serve request's root span (or a child,
         # when the handle call itself runs inside a traced request) —
         # replica pick + probes + the actor-call submit all parent here,
         # so the routing cost is visible next to replica execution time
         with tracing.span(f"serve.route {self._deployment}",
                           method=self._method, stream=self._stream):
-            return self._remote_inner(request)
+            return self._remote_inner(request, _replica)
 
-    def _remote_inner(self, request: Any):
-        if self._model_id:
+    def _remote_inner(self, request: Any, _replica: Any = None):
+        if _replica is not None:
+            replica = _replica  # slot-claimed by call() — must dispatch
+            # to the replica the slot was charged to, or the inflight map
+            # drifts from real placement
+        elif self._model_id:
             replica = self._pick_replica_affine()
         else:
             replica = self._pick_replica()
+        # Deadline stamp (Serve request_timeout_s): the replica call — and
+        # everything it fans out to — inherits an absolute deadline;
+        # expiry anywhere sheds/interrupts instead of running on forever.
+        timeout_s = self._routing.request_timeout_s
         if self._stream:
-            return replica.handle_request_stream.options(
-                num_returns="streaming").remote(request, self._method,
-                                                self._model_id)
-        return replica.handle_request.remote(request, self._method,
-                                             self._model_id)
+            method = replica.handle_request_stream.options(
+                num_returns="streaming")
+            if timeout_s is not None and config.deadlines:
+                method = method.options(deadline_s=timeout_s)
+            return method.remote(request, self._method, self._model_id)
+        method = replica.handle_request
+        if timeout_s is not None and config.deadlines:
+            method = method.options(deadline_s=timeout_s)
+        return method.remote(request, self._method, self._model_id)
+
+    def _acquire_slot(self):
+        """Router-side admission: claim the least-loaded live replica
+        still below ``max_ongoing_requests`` AS COUNTED BY THIS ROUTER
+        (reference: the Serve router tracks per-replica in-flight and
+        never over-dispatches).  Returns the claimed replica, None when
+        every replica is full (caller backs off / sheds — the request
+        never queues replica-side, which is what keeps admitted p99
+        bounded under overload), or the sentinel False when admission is
+        unenforced (no cap / kill switch) and the caller should use the
+        legacy probe-based pick."""
+        routing = self._routing
+        self._refresh()
+        with routing.lock:
+            cap = routing.max_ongoing
+        if cap <= 0 or not config.serve_backpressure or self._model_id:
+            # unenforced (no cap / kill switch), or a multiplexed request
+            # — model affinity picks its own replica, so a slot charged
+            # to the least-loaded one would just drift the inflight map;
+            # multiplexed calls rely on the replica-side gate
+            return False
+        replicas = self._live_replicas()
+        # NOT pruned against the live set: a probe-suspected replica's
+        # in-flight work is still running — resetting its count to zero
+        # on recovery would over-admit; entries self-clean because every
+        # claim's finally releases (pop at count<=1)
+        with routing.lock:
+            if not replicas:
+                return None
+            count, _, best = min(
+                (routing.inflight.get(r, 0), i, r)
+                for i, r in enumerate(replicas))
+            if count >= cap:
+                return None
+            routing.inflight[best] = count + 1
+        return best
+
+    def _release_slot(self, replica):
+        routing = self._routing
+        with routing.lock:
+            count = routing.inflight.get(replica, 0)
+            if count > 1:
+                routing.inflight[replica] = count - 1
+            else:
+                routing.inflight.pop(replica, None)
+
+    def call(self, request: Any = None, timeout: Optional[float] = None):
+        """Submit AND resolve, under router-side admission: a slot on the
+        least-loaded replica is claimed BEFORE submitting (so an
+        overloaded deployment rejects in microseconds at the router —
+        the request never sits in a replica queue inflating its
+        latency), re-tried under a per-request budget (jittered backoff
+        from ``util/retry.py``, short — the wait is for an in-flight
+        request to finish); when every attempt finds all replicas full —
+        the deployment is saturated — the request is SHED with a typed
+        ``BackPressureError`` (HTTP proxy: 503 + Retry-After).  The
+        replica-side ``max_ongoing_requests`` check stays as the
+        authoritative gate (other routers/drivers race this one); plain
+        ``.remote()`` callers observe those rejects at ``get()``."""
+        import ray_tpu
+        from ray_tpu.core.exceptions import BackPressureError
+        from ray_tpu.util.retry import BackoffPolicy
+
+        budget = max(0, config.serve_reject_retry_budget)
+        backoff = BackoffPolicy(base_s=0.01, max_s=0.25)
+        last: Optional[BackPressureError] = None
+        for attempt in range(budget + 1):
+            if attempt:
+                time.sleep(backoff.delay(attempt - 1))
+            slot = self._acquire_slot()
+            if slot is None:
+                last = BackPressureError(
+                    f"all replicas of {self._deployment!r} at "
+                    f"max_ongoing_requests")
+                continue
+            try:
+                return ray_tpu.get(
+                    self.remote(request, _replica=slot or None),
+                    timeout=timeout)
+            except BackPressureError as e:
+                last = e  # replica-side race (another router's traffic)
+            finally:
+                if slot is not False:
+                    self._release_slot(slot)
+        raise BackPressureError(
+            f"deployment {self._deployment!r} saturated: "
+            f"{budget + 1} attempts all rejected ({last})")
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,

@@ -80,6 +80,20 @@ config.define("chaos_net_channels", str, "data",
               "'data').  Defaults to data only — peer control frames "
               "have no per-frame retry, so dropping them is an explicit "
               "opt-in.", live=True)
+config.define("chaos_exec_delay_ms", float, 0.0,
+              "Execution chaos: inject this delay (milliseconds) before a "
+              "matching task executes on a worker — makes an executor "
+              "pathologically slow without sleeps in user code "
+              "(deadline/shedding tests).  0 disables.", live=True)
+config.define("chaos_exec_delay_names", str, "",
+              "Execution chaos: csv of substrings matched against task "
+              "names (e.g. 'Replica.handle_request'); empty = every "
+              "task.", live=True)
+config.define("chaos_exec_delay_p", float, 1.0,
+              "Execution chaos: probability a matching call is delayed, "
+              "drawn from a deterministic RNG seeded by "
+              "RAY_TPU_CHAOS_NET_SEED (replayable delay sequences).",
+              live=True)
 config.define("chaos_net_partition_file", str, "",
               "Network chaos: path of a JSON control file "
               "({'partitions': {'<peer-node-id-or-*>': "
@@ -89,7 +103,7 @@ config.define("chaos_net_partition_file", str, "",
               "rewriting the file.  Empty disables.", live=True)
 
 __all__ = ["NodeKiller", "NetworkChaos", "net_fault", "configure_net",
-           "net"]
+           "net", "exec_delay"]
 
 
 class NodeKiller:
@@ -310,6 +324,38 @@ def configure_net(**kwargs) -> NetworkChaos:
     global _net
     _net = NetworkChaos(**kwargs) if kwargs else NetworkChaos.from_env()
     return _net
+
+
+_exec_rng: Optional[random.Random] = None
+_exec_rng_lock = make_lock("chaos.exec_delay")
+
+
+def exec_delay(task_name: str) -> float:
+    """Seeded slow-executor injection, called by the worker between
+    arg-pull and exec: sleep ``RAY_TPU_CHAOS_EXEC_DELAY_MS`` when the task
+    name matches ``RAY_TPU_CHAOS_EXEC_DELAY_NAMES`` (csv substrings; empty
+    matches all) with probability ``RAY_TPU_CHAOS_EXEC_DELAY_P`` (drawn
+    from an RNG seeded by ``RAY_TPU_CHAOS_NET_SEED``, so delay sequences
+    replay).  Returns the injected delay in seconds (0 = none).  Live
+    flags: the check costs two env reads per execution when disabled."""
+    global _exec_rng
+    ms = config.chaos_exec_delay_ms
+    if ms <= 0:
+        return 0.0
+    names = [n.strip() for n in config.chaos_exec_delay_names.split(",")
+             if n.strip()]
+    if names and not any(n in task_name for n in names):
+        return 0.0
+    p = config.chaos_exec_delay_p
+    if p < 1.0:
+        with _exec_rng_lock:
+            if _exec_rng is None:
+                _exec_rng = random.Random(config.chaos_net_seed)
+            if _exec_rng.random() >= p:
+                return 0.0
+    delay = ms / 1e3
+    time.sleep(delay)
+    return delay
 
 
 def net_fault(channel: str, peer: Optional[str] = None,

@@ -1,0 +1,519 @@
+"""End-to-end request deadlines & overload protection.
+
+Covers the deadline-and-shedding layer: expiry at every hop (raylet
+admission, queued past deadline, worker pre-exec, mid-exec interrupt),
+recursive cancel fan-out (relayed AND direct transport), bounded-queue
+shedding, Serve replica backpressure -> router retry -> 503 shed, the
+typed OOM error, and the RAY_TPU_DEADLINES kill switch — with task-event
+and metric-counter asserts throughout.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import (
+    BackPressureError,
+    DeadlineExceededError,
+    OutOfMemoryError,
+    TaskCancelledError,
+)
+from ray_tpu.core.config import config
+
+
+def _events_for(state, name=None):
+    from ray_tpu.util import state as _state
+
+    evs = [e for e in _state.raw_task_events() if e["state"] == state]
+    if name is not None:
+        evs = [e for e in evs if name in e["name"]]
+    return evs
+
+
+def _raylet():
+    from ray_tpu.core.worker import global_worker
+
+    return global_worker().raylet
+
+
+def _heartbeat_age(path):
+    """Seconds since the heartbeat file was last touched (inf = never)."""
+    try:
+        return time.time() - os.stat(path).st_mtime
+    except OSError:
+        return float("inf")
+
+
+def _warm_pool(n=8):
+    """Spin the worker pool up to size before timing-sensitive fan-out:
+    on a cold pool, dispatch pipelines queued tasks serially onto the
+    first spawned workers (~2s per spawn), so 'concurrent' children
+    would run one after another."""
+    @ray_tpu.remote
+    def warm():
+        return "ok"
+
+    ray_tpu.get([warm.remote() for _ in range(n)], timeout=60)
+
+
+def _make_beat():
+    """Heartbeating task, defined in a nested scope so cloudpickle ships
+    it BY VALUE (workers need not import the test module).  Short-sleep
+    loop: interruptible at bytecode boundaries, and the mtime of ``path``
+    proves whether work is STILL running."""
+
+    @ray_tpu.remote
+    def beat(path, ticks=200):
+        for _ in range(ticks):
+            with open(path, "w") as f:
+                f.write(str(time.time()))
+            time.sleep(0.02)
+        with open(path + ".done", "w") as f:
+            f.write("completed")
+        return "completed"
+
+    return beat
+
+
+# --------------------------------------------------------------------------
+# deadline expiry at each hop
+
+
+def test_deadline_admission_and_pre_exec(ray_start_regular, tmp_path):
+    """An already-expired task is dropped before execution (typed error,
+    marker never written) and the expired counter moves."""
+    marker = str(tmp_path / "m")
+    before = _raylet()._m_deadline_exceeded
+
+    # a ref dependency keeps the submit on the relayed path (direct
+    # leases take dependency-free specs), so raylet ADMISSION sees it
+    dep = ray_tpu.put("x")
+
+    @ray_tpu.remote
+    def write(path, _dep):
+        with open(path, "w") as f:
+            f.write("ran")
+        return 1
+
+    ref = write.options(deadline_s=0).remote(marker, dep)
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(ref, timeout=10)
+    assert not os.path.exists(marker)
+
+    # pre-exec hop (direct transport): no deps -> may ride a lease
+    ref2 = write.options(deadline_s=0).remote(marker, None)
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(ref2, timeout=10)
+    assert not os.path.exists(marker)
+
+    def counter_moved():
+        # worker-enforced expiries are counted when the done lands
+        return _raylet().call(
+            lambda: _raylet()._m_deadline_exceeded).result() >= before + 2
+    deadline = time.time() + 5
+    while time.time() < deadline and not counter_moved():
+        time.sleep(0.05)
+    assert counter_moved()
+    assert _events_for("EXPIRED", name="write")
+
+
+def test_deadline_expires_in_queue(ray_start_regular, tmp_path):
+    """A task that out-waits its deadline in the ready queue is shed by
+    the raylet's expiry timer WITHOUT running (no wasted exec)."""
+    @ray_tpu.remote(num_cpus=1)
+    def blocker():
+        time.sleep(2.5)
+        return "done"
+
+    blockers = [blocker.remote() for _ in range(4)]  # 4 CPUs: queue fills
+    time.sleep(0.2)
+    marker = str(tmp_path / "queued")
+    beat = _make_beat()
+    ref = beat.options(deadline_s=0.4, num_cpus=1).remote(marker, 5)
+    t0 = time.time()
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(ref, timeout=10)
+    # raised at ~the deadline, long before the blockers free a worker
+    assert time.time() - t0 < 2.0
+    assert not os.path.exists(marker)
+    assert ray_tpu.get(blockers, timeout=30) == ["done"] * 4
+    assert _events_for("EXPIRED", name="beat")
+
+
+def test_deadline_mid_exec_interrupt_and_fanout(ray_start_regular, tmp_path):
+    """A running task is interrupted AT its deadline; nested work it
+    spawned (which inherited the deadline) stops within 1s — verified by
+    the child's heartbeat file going quiet."""
+    child_hb = str(tmp_path / "child")
+    parent_hb = str(tmp_path / "parent")
+    beat = _make_beat()
+
+    @ray_tpu.remote
+    def parent(child_path, my_path):
+        beat.remote(child_path)  # inherits the enclosing deadline
+        for _ in range(200):
+            with open(my_path, "w") as f:
+                f.write("beat")
+            time.sleep(0.02)
+        return "completed"
+
+    _warm_pool()  # parent + child must run concurrently, not pipelined
+    ref = parent.options(deadline_s=0.8).remote(child_hb, parent_hb)
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(ref, timeout=15)
+    # zero still-running downstream work within 1s of the expiry
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        if _heartbeat_age(child_hb) >= 1.0 and _heartbeat_age(parent_hb) >= 1.0:
+            break
+        time.sleep(0.1)
+    time.sleep(1.0)
+    assert _heartbeat_age(child_hb) >= 1.0, "child still running after expiry"
+    assert not os.path.exists(child_hb + ".done")
+    assert not os.path.exists(parent_hb + ".done")
+
+
+# --------------------------------------------------------------------------
+# cancel fan-out
+
+
+def test_cancel_recursive_fanout(ray_start_regular, tmp_path):
+    """cancel(recursive=True) on a running parent reaps its children
+    within 1s (marker files go quiet, nothing completes)."""
+    hbs = [str(tmp_path / f"c{i}") for i in range(2)]
+    beat = _make_beat()
+
+    @ray_tpu.remote
+    def parent(paths):
+        for p in paths:
+            beat.remote(p)
+        for _ in range(300):
+            time.sleep(0.02)
+        return "completed"
+
+    _warm_pool()  # children must run CONCURRENTLY, not pipelined serially
+    ref = parent.remote(hbs)
+    # let the children actually start beating
+    deadline = time.time() + 10
+    while time.time() < deadline and not all(os.path.exists(p) for p in hbs):
+        time.sleep(0.05)
+    assert all(os.path.exists(p) for p in hbs)
+    assert ray_tpu.cancel(ref, recursive=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    time.sleep(1.2)
+    for p in hbs:
+        assert _heartbeat_age(p) >= 1.0, "child kept running after cancel"
+        assert not os.path.exists(p + ".done")
+    assert _events_for("CANCELLED")
+    assert _raylet().call(lambda: _raylet()._m_cancelled).result() >= 1
+
+
+def test_cancel_reaches_direct_transport(ray_start_regular, tmp_path):
+    """Regression (PR 11 satellite): a call in flight on a directly-dialed
+    channel — the raylet never dispatched it — must still be cancellable;
+    the cancel has to reach the callee worker's in-flight registry."""
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote
+    class Slow:
+        def ping(self):
+            return "pong"
+
+        def work(self, path):
+            for _ in range(300):
+                with open(path, "w") as f:
+                    f.write(str(time.time()))
+                time.sleep(0.02)
+            with open(path + ".done", "w") as f:
+                f.write("completed")
+            return "completed"
+
+    a = Slow.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    # second call engages the direct channel (first is relayed, observed)
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    d = global_worker()._direct
+    assert d is not None and any(
+        not isinstance(k, tuple) for k in d._channels), \
+        "direct channel did not engage — test precondition broken"
+
+    hb = str(tmp_path / "direct")
+    ref = a.work.remote(hb)
+    deadline = time.time() + 10
+    while time.time() < deadline and not os.path.exists(hb):
+        time.sleep(0.05)
+    assert os.path.exists(hb)
+    # the work call is in flight on the DIRECT channel now
+    assert any(ch.pending for ch in d._channels.values())
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    time.sleep(1.2)
+    assert _heartbeat_age(hb) >= 1.0, "direct call kept running after cancel"
+    assert not os.path.exists(hb + ".done")
+    # the actor survives the cancel and keeps serving
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+
+def test_async_actor_mid_exec_deadline_and_cancel(ray_start_regular,
+                                                  tmp_path):
+    """Asyncio actor calls are interruptible mid-await: deadline expiry
+    and cancel() cancel the asyncio task on its loop (typed error at the
+    caller, no run-to-completion), and the shared loop survives."""
+    @ray_tpu.remote
+    class Aio:
+        async def ping(self):
+            return "pong"
+
+        async def work(self, path):
+            import asyncio as aio
+
+            with open(path, "w") as f:
+                f.write("started")
+            await aio.sleep(30)
+            with open(path + ".done", "w") as f:
+                f.write("completed")
+            return "completed"
+
+    a = Aio.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+    p1 = str(tmp_path / "dl")
+    t0 = time.time()
+    with pytest.raises(DeadlineExceededError):
+        ray_tpu.get(a.work.options(deadline_s=0.5).remote(p1), timeout=20)
+    assert time.time() - t0 < 10  # interrupted at the await, not at 30s
+    assert not os.path.exists(p1 + ".done")
+
+    p2 = str(tmp_path / "cx")
+    ref = a.work.remote(p2)
+    deadline = time.time() + 10
+    while time.time() < deadline and not os.path.exists(p2):
+        time.sleep(0.05)
+    assert os.path.exists(p2)
+    assert ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    assert not os.path.exists(p2 + ".done")
+    # interleaved calls on the shared loop keep serving
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+
+# --------------------------------------------------------------------------
+# bounded queues
+
+
+def test_queue_depth_sheds_lowest_headroom(ray_start_regular):
+    """With RAY_TPU_MAX_QUEUE_DEPTH set, a full actor call queue sheds
+    the lowest-deadline-headroom task (typed BackPressureError) instead
+    of queueing without bound.  (The actor queue is the deterministic
+    bounded queue: the ready queue drains into worker sockets via
+    dispatch pipelining, so its depth depends on pool/scheduler timing.)"""
+    old_depth = config.max_queue_depth
+    old_direct = config.direct_calls
+    old_pipeline = config.actor_pipeline_depth
+    # keep calls RELAYED (the direct transport executes callee-side and
+    # the raylet queue under test never fills) and un-pipelined (pipelined
+    # calls sit in the worker socket, not actor.queue)
+    config.direct_calls = False
+    config.actor_pipeline_depth = 1
+    config.max_queue_depth = 4
+    try:
+        before = _raylet().call(lambda: _raylet()._m_shed).result()
+
+        @ray_tpu.remote
+        class Busy:
+            def ping(self):
+                return "pong"
+
+            def work(self, sec):
+                time.sleep(sec)
+                return "done"
+
+        a = Busy.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+        blocker = a.work.remote(2.0)   # dispatched (pipeline depth 1)
+        time.sleep(0.2)
+        fillers = [a.ping.remote() for _ in range(4)]  # queue to bound
+        time.sleep(0.2)
+        # tightest headroom of all -> this one is the shed victim
+        victim = a.ping.options(deadline_s=5.0).remote()
+        with pytest.raises(BackPressureError):
+            ray_tpu.get(victim, timeout=10)
+        # everything already queued survives and completes
+        assert ray_tpu.get(fillers, timeout=30) == ["pong"] * 4
+        assert ray_tpu.get(blocker, timeout=30) == "done"
+        assert _raylet().call(
+            lambda: _raylet()._m_shed).result() >= before + 1
+        assert _events_for("SHED")
+    finally:
+        config.max_queue_depth = old_depth
+        config.direct_calls = old_direct
+        config.actor_pipeline_depth = old_pipeline
+
+
+# --------------------------------------------------------------------------
+# Serve: replica reject -> router retry -> shed
+
+
+@pytest.fixture
+def serve_overload(monkeypatch):
+    # seeded slow-executor injection makes every replica call slow
+    # WITHOUT sleeps in deployment code (chaos satellite)
+    monkeypatch.setenv("RAY_TPU_CHAOS_EXEC_DELAY_MS", "600")
+    # the Replica.user seam sleeps INSIDE the admission-counted window
+    # (ongoing piles up; the worker pre-exec seam would sleep before it)
+    monkeypatch.setenv("RAY_TPU_CHAOS_EXEC_DELAY_NAMES", "Replica.user")
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_replica_reject_router_retry_shed(serve_overload):
+    """A saturated replica REJECTS (BackPressureError); the router's
+    retry budget finds a free replica when one exists and sheds (HTTP
+    503 + Retry-After) when the whole deployment is saturated."""
+    from ray_tpu import serve
+
+    @serve.deployment(name="tight", max_ongoing_requests=1, num_replicas=1)
+    def fast(req):
+        return {"ok": True}
+
+    handle = serve.run(fast.bind(), route_prefix="/tight")
+    port = serve.http_port()
+
+    def http_post():
+        try:
+            r = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tight", data=b"{}", timeout=30)
+            return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(http_post()))
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    codes = sorted(c for c, _, _ in results)
+    assert 200 in codes, codes          # admitted work completed
+    assert 503 in codes, codes          # saturation shed, not queued
+    shed = next(h for c, h, _ in results if c == 503)
+    assert shed.get("Retry-After") == "1"
+    body = next(b for c, _, b in results if c == 503)
+    assert "saturated" in json.loads(body)["error"]
+
+    # a router-level reject is retried INTO capacity once the replica
+    # frees: a single sequential call always lands (chaos delay 600ms,
+    # budget 3 with backoff covers it)
+    assert handle.call({"x": 1}, timeout=30) == {"ok": True}
+
+    # the replica-side gate stays authoritative: raw calls that bypass
+    # the router's slot accounting (a second router, plain .remote())
+    # get the typed reject once max_ongoing_requests is reached
+    import ray_tpu as rt
+    replica = rt.get_actor("SERVE_REPLICA::tight#0", namespace="serve")
+    raws = [replica.handle_request.remote({"x": i}) for i in range(4)]
+    rejected = 0
+    for r in raws:
+        try:
+            rt.get(r, timeout=30)
+        except BackPressureError:
+            rejected += 1
+    assert rejected >= 1
+    stats = rt.get(replica.stats.remote(), timeout=10)
+    assert stats["rejected"] >= 1
+    assert stats["max_ongoing_requests"] == 1
+
+
+# --------------------------------------------------------------------------
+# OOM: typed, retry-budget-counted
+
+
+@pytest.mark.slow
+def test_oom_typed_error_and_retry(tmp_path):
+    """An OOM-killed task surfaces as OutOfMemoryError (with forensics
+    excerpt) when its retry budget is spent, and retries within budget
+    like the reference."""
+    from ray_tpu.cluster_utils import Cluster
+
+    usage = tmp_path / "usage"
+    usage.write_text("0.1")
+    c = Cluster(initialize_head=True, head_resources={"num_cpus": 2},
+                env={"RAY_TPU_MEMORY_MONITOR_INTERVAL_S": "0.1",
+                     "RAY_TPU_MEMORY_USAGE_THRESHOLD": "0.9",
+                     "RAY_TPU_MEMORY_USAGE_FILE": str(usage)})
+    try:
+        c.wait_for_nodes(1)
+        c.connect()
+        marker = tmp_path / "attempts"
+        # ref dep keeps hog off the direct-lease path: the relayed
+        # dispatch is what the retry-budget accounting covers
+        dep = ray_tpu.put("pin")
+
+        @ray_tpu.remote(num_cpus=1, max_retries=0)
+        def hog(path, _dep):
+            with open(path, "a") as f:
+                f.write("x")
+            time.sleep(3.0)
+            return "done"
+
+        ref = hog.remote(str(marker), dep)
+        deadline = time.time() + 30
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.05)
+        assert marker.exists()
+        usage.write_text("0.99")
+        with pytest.raises(OutOfMemoryError, match="OOM-killed"):
+            ray_tpu.get(ref, timeout=30)
+        usage.write_text("0.1")
+
+        # within budget: the OOM kill consumes a retry, then succeeds
+        marker2 = tmp_path / "attempts2"
+        ref2 = hog.options(max_retries=2).remote(str(marker2), dep)
+        deadline = time.time() + 30
+        while time.time() < deadline and not marker2.exists():
+            time.sleep(0.05)
+        usage.write_text("0.99")
+        time.sleep(0.6)
+        usage.write_text("0.1")
+        assert ray_tpu.get(ref2, timeout=60) == "done"
+        assert marker2.read_text().count("x") >= 2
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------------------------------------------
+# kill switch
+
+
+def test_deadlines_kill_switch(tmp_path, monkeypatch):
+    """RAY_TPU_DEADLINES=0 restores pre-deadline behavior: deadline_s is
+    a no-op, slow work completes."""
+    config.reload("deadlines")
+    config.deadlines = False
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def slowish():
+            time.sleep(0.6)
+            return "completed"
+
+        assert ray_tpu.get(slowish.options(deadline_s=0.1).remote(),
+                           timeout=30) == "completed"
+    finally:
+        config.deadlines = True
+        ray_tpu.shutdown()
