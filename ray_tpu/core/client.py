@@ -84,6 +84,16 @@ class ClientWorker(Worker):
             lambda samples, dropped: self._send(
                 {"t": "profile_samples", "samples": samples,
                  "dropped": dropped}))
+        # metric time-series delta points ride it too (raylet -> GCS
+        # metrics table); registered unconditionally — the per-process
+        # flusher only runs once a metric is registered, and checks the
+        # metrics_history flag itself
+        from ray_tpu.util import metrics as _metrics_mod
+
+        _metrics_mod.set_points_target(
+            lambda points, dropped: self._send(
+                {"t": "metric_points", "points": points,
+                 "dropped": dropped}))
         # Direct worker→worker transport (remote-driver caller side): the
         # raylet brokers actor addresses / worker leases over the request
         # protocol; direct_fence control frames arrive on the read loop.

@@ -221,6 +221,33 @@ config.define("internal_metrics_interval_s", float, 1.0,
               "Flush period for the runtime's own ray_tpu_internal_* "
               "metrics (queue depth, dispatch latency, store bytes, codec "
               "counters) into the metrics KV -> /metrics.  0 disables.")
+config.define("metrics_table_max", int, 20000,
+              "GCS-side cap per NODE on retained metric time-series "
+              "points (add_metric_points / query_metrics); oldest "
+              "evicted first, evictions counted in metrics_table_stats.")
+
+# --- alerting ----------------------------------------------------------------
+config.define("alerts", bool, True,
+              "Evaluate alert rules in the GCS on the metrics flush "
+              "cadence (RAY_TPU_ALERTS=0 disables the rule engine; the "
+              "alert table and list_alerts keep working, nothing new "
+              "fires).")
+config.define("alerts_eval_interval_s", float, 2.0,
+              "Period between alert rule evaluations in the GCS health "
+              "monitor.")
+config.define("alerts_table_max", int, 1000,
+              "GCS-side cap on retained alert records (firing/resolved "
+              "transitions); oldest evicted first, evictions counted.")
+config.define("alerts_rules", str, "",
+              "Extra alert rules as a JSON list of rule dicts, merged "
+              "over (and by name overriding) the built-in defaults "
+              "(util.alerts.default_rules); re-read on every evaluation "
+              "so tests can inject rules live.", live=True)
+config.define("alerts_default_rules", bool, True,
+              "Ship the built-in default rule set (false-suspect rate, "
+              "fenced-frame spikes, replication-repair pressure, Serve "
+              "shed-ratio burn rate, telemetry drop counters).  0 leaves "
+              "only RAY_TPU_ALERTS_RULES rules active.")
 
 # --- tensor plane -----------------------------------------------------------
 config.define("mesh_default_axes", str, "dp,tp", "")

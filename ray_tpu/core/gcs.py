@@ -127,6 +127,19 @@ class GcsCore:
         # state — never persisted.
         self._profile_samples: Dict[str, deque] = {}  # guard: _lock
         self._profile_dropped = 0  # guard: _lock
+        # Metrics time-series table: node_id -> deque of timestamped DELTA
+        # points (see metrics.collect_points), bounded per node
+        # (config.metrics_table_max); producer-side ring drops and GCS-side
+        # evictions both count.  Soft state — never persisted.
+        self._metric_points: Dict[str, deque] = {}  # guard: _lock
+        self._metric_points_dropped = 0  # guard: _lock
+        # Alert table: transition log (firing/resolved records) bounded by
+        # config.alerts_table_max, plus the live currently-firing view.
+        # Only the health-monitor thread evaluates rules; readers snapshot
+        # under _lock.
+        self._alerts_log: deque = deque()  # guard: _lock
+        self._alerts_active: Dict[str, dict] = {}  # guard: _lock
+        self._alerts_dropped = 0  # guard: _lock
         # token -> {"event": Event, "reports": {node_id: payload}, "want"}
         # for targeted node queries (live stack dumps, log listings)
         # relayed through the node pubsub; replies land via the
@@ -655,6 +668,7 @@ class GcsCore:
             period = max(0.05, config.gcs_heartbeat_interval_s / 2)
             soft_sweep_at = time.monotonic() + self._SOFT_KV_TTL_S
             metrics_at = time.monotonic() + 1.0
+            alerts_at = time.monotonic() + config.alerts_eval_interval_s
             while not self._stop.wait(period):
                 timeout = config.gcs_node_timeout_s
                 suspect_after = config.gcs_node_suspect_s
@@ -689,6 +703,13 @@ class GcsCore:
                 if now >= metrics_at:
                     metrics_at = now + 1.0
                     self._flush_health_metrics()
+                if now >= alerts_at:
+                    alerts_at = now + max(
+                        0.25, config.alerts_eval_interval_s)
+                    try:
+                        self._eval_alerts()
+                    except Exception:  # noqa: BLE001 — rules never kill
+                        pass           # the failure detector's thread
                 if now >= soft_sweep_at:
                     # TTL sweep of soft KV (dead metric producers)
                     soft_sweep_at = now + self._SOFT_KV_TTL_S
@@ -864,12 +885,18 @@ class GcsCore:
                     _metrics.Gauge, "ray_tpu_internal_node_drains",
                     "Nodes with a recorded drain lifecycle (draining or "
                     "drained)", tag_keys=("node",)).set_default_tags(tags),
+                "alerts_firing": _metrics.internal_metric(
+                    _metrics.Gauge, "ray_tpu_internal_alerts_firing",
+                    "Alert rules currently in the firing state",
+                    tag_keys=("node",)).set_default_tags(tags),
             }
             # delta-sync baselines: the _m_* counters are bumped inline
             # under _lock; the flusher ships increments into the Counter
             # instruments so restarts/re-inits never double-count
             self._gm_last = {"fenced": 0, "false_suspects": 0, "deaths": 0,
                              "probe_deaths": 0}
+            # time-series baselines for collect_points (flusher thread only)
+            self._gm_points_last = {}
         except Exception:  # noqa: BLE001 — stats-only fallback
             self._gm = None
 
@@ -902,6 +929,16 @@ class GcsCore:
                           _json.dumps(payload).encode()))
         if items:
             self.kv_multi_put("metrics", items)
+        if config.metrics_history:
+            # the GCS's own series feed the time-series table too — the
+            # alert engine's detector rules read them (false suspects,
+            # fenced frames) like any other producer's
+            from ray_tpu.util import metrics as _metrics
+
+            points = _metrics.collect_points(self._gm.values(),
+                                             self._gm_points_last)
+            if points:
+                self.add_metric_points("gcs", points)
 
     def stop(self):
         self._stop.set()
@@ -1541,6 +1578,153 @@ class GcsCore:
                     "num_dropped": self._profile_dropped,
                     "nodes": sorted(self._profile_samples)}
 
+    # ------------------------------------------- metrics time-series table
+
+    def add_metric_points(self, node_id: str, points: List[dict],
+                          dropped: int = 0,
+                          incarnation: Optional[int] = None):
+        """Batch append from one node's metric point ring (every process
+        on the node funnels through its raylet; the standalone GCS feeds
+        its own points under the "gcs" key).  Points are DELTAS — counter
+        and histogram-bucket increments per flush interval, gauge value
+        changes — so merging across producer restarts needs no reset
+        heuristics.  ``dropped`` counts points the producer's ring shed.
+        Stamped batches from a fenced node are rejected whole."""
+        cap = max(1, config.metrics_table_max)
+        with self._lock:
+            if not self._fence_ok(node_id, incarnation):
+                return
+            self._metric_points_dropped += dropped
+            log = self._metric_points.get(node_id)
+            if log is None:
+                log = self._metric_points[node_id] = deque(maxlen=cap)
+            for p in points:
+                p["node"] = node_id
+                if len(log) == cap:
+                    self._metric_points_dropped += 1  # eviction, counted
+                log.append(p)
+
+    def _metric_points_snapshot(self, name: Optional[str] = None,
+                                node_id: Optional[str] = None) -> List[dict]:
+        """Flat point list (records are append-only after ingest, so
+        handing out references is safe)."""
+        with self._lock:
+            if node_id is not None:
+                logs = [log for nid, log in self._metric_points.items()
+                        if nid.startswith(node_id)]
+            else:
+                logs = list(self._metric_points.values())
+            if name is not None:
+                return [p for log in logs for p in log
+                        if p["name"] == name]
+            return [p for log in logs for p in log]
+
+    def query_metrics(self, name: Optional[str] = None, op: str = "range",
+                      tags: Optional[Dict[str, str]] = None,
+                      node_id: Optional[str] = None,
+                      since: Optional[float] = None,
+                      until: Optional[float] = None,
+                      window_s: float = 60.0, q: float = 0.99,
+                      limit: int = 2000) -> dict:
+        """Query the time-series table.  ``op``:
+
+        * ``range`` — the matching points themselves (newest ``limit``).
+        * ``rate`` — per-second increase over the trailing ``window_s``.
+        * ``quantile`` — histogram quantile ``q`` over the window (bucket
+          deltas merged first — never averaged percentiles).
+        * ``series`` — per-(name, tags) activity summary, the feed for
+          ``ray_tpu metrics top``.
+
+        The math lives in ``util.metrics_query`` (pure, shared with the
+        alert engine)."""
+        from ray_tpu.util import metrics_query as mq
+
+        pts = mq.filter_points(
+            self._metric_points_snapshot(name, node_id),
+            name, tags, since, until)
+        if op == "range":
+            return {"op": "range", "count": len(pts),
+                    "truncated": len(pts) > limit,
+                    "points": pts[-max(0, limit):]}
+        now = until
+        if now is None:
+            now = max((p["ts"] for p in pts), default=time.time())
+        if op == "rate":
+            return {"op": "rate", "window_s": window_s,
+                    "points": len(pts),
+                    "rate": mq.rate(pts, window_s, now=now)}
+        if op == "quantile":
+            return {"op": "quantile", "q": q, "window_s": window_s,
+                    "points": len(pts),
+                    "value": mq.quantile_over_window(pts, q, window_s,
+                                                     now=now)}
+        if op == "series":
+            return {"op": "series",
+                    "series": mq.series_summary(pts, window_s, now=now)}
+        raise ValueError(f"unknown query op {op!r}")
+
+    def metrics_table_stats(self) -> dict:
+        with self._lock:
+            num = sum(len(v) for v in self._metric_points.values())
+            series = {(p["name"], tuple(map(tuple, p.get("tags", ()))))
+                      for log in self._metric_points.values() for p in log}
+            return {"num_points": num, "num_series": len(series),
+                    "num_dropped": self._metric_points_dropped,
+                    "nodes": sorted(self._metric_points)}
+
+    # ------------------------------------------------------- alert table
+
+    def list_alerts(self, state: Optional[str] = None,
+                    limit: int = 100) -> dict:
+        """The live firing view plus the transition log (newest first).
+        ``state`` filters the log ("firing" / "resolved")."""
+        with self._lock:
+            firing = [dict(rec) for rec in self._alerts_active.values()]
+            log = [dict(rec) for rec in self._alerts_log]
+            dropped = self._alerts_dropped
+        firing.sort(key=lambda r: r["since"])
+        log.reverse()
+        if state is not None:
+            log = [rec for rec in log if rec["state"] == state]
+        return {"firing": firing, "log": log[:max(0, limit)],
+                "num_dropped": dropped}
+
+    def _eval_alerts(self):
+        """One alert-engine pass over the metrics table (health-monitor
+        thread, alerts_eval_interval_s cadence).  Rule evaluation itself
+        is pure (util.alerts); this wrapper snapshots state under _lock,
+        evaluates unlocked, then commits transitions and the firing
+        gauge."""
+        from ray_tpu.util import alerts as alerts_mod
+
+        if not config.alerts:
+            return
+        rules = alerts_mod.load_rules()
+        if not rules:
+            return
+        now = time.time()
+
+        def query(name, tags, since):
+            from ray_tpu.util import metrics_query as mq
+
+            return mq.filter_points(self._metric_points_snapshot(name),
+                                    name, tags, since)
+
+        with self._lock:
+            active = {k: dict(v) for k, v in self._alerts_active.items()}
+        records = alerts_mod.evaluate_rules(rules, query, now, active)
+        cap = max(1, config.alerts_table_max)
+        with self._lock:
+            self._alerts_active = active
+            for rec in records:
+                if len(self._alerts_log) >= cap:
+                    self._alerts_log.popleft()
+                    self._alerts_dropped += 1  # eviction, counted
+                self._alerts_log.append(rec)
+            firing = len(active)
+        if self._gm is not None:
+            self._gm["alerts_firing"].set(firing)
+
     # ------------------------- targeted node queries (stacks / logs) ----
 
     def _node_query_multi(self, node_ids: List[str], kind: str,
@@ -1671,6 +1855,8 @@ _OPS = {
     "summarize_task_events",
     "add_trace_spans", "get_trace", "list_trace_spans", "trace_table_stats",
     "add_profile_samples", "list_profile_samples", "profile_table_stats",
+    "add_metric_points", "query_metrics", "metrics_table_stats",
+    "list_alerts",
     "collect_stacks", "node_query", "node_query_report",
     "state_snapshot",
 }
@@ -1867,7 +2053,10 @@ class GcsClient:
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
 
-    def _call(self, op: str, *args, **kw):
+    def _call(self, op: str, /, *args, **kw):
+        # `op` is positional-only: table ops take an `op=` KWARG of their
+        # own (query_metrics op="rate") which must land in **kw, not
+        # collide with the method-name parameter
         if self._closed:
             raise ConnectionError("GCS connection lost")
         from ray_tpu.util import tracing as _tracing

@@ -64,6 +64,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.util import chaos as _chaos
+from ray_tpu.util import metrics as _metrics_mod
 from ray_tpu.util import profiling as _profiling
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.util.locks import make_lock
@@ -582,6 +583,17 @@ class Raylet:
         self._profile_buf: deque = deque()
         self._profile_export_dropped = 0   # since last flush (shipped)
         self._profile_dropped_total = 0    # lifetime (metrics)
+        # Metric time-series export: delta points from this process's
+        # registry ring plus worker batches ("metric_points" control
+        # frames) buffer here and batch-flush to the per-node GCS metrics
+        # table on the internal-metrics cadence.
+        self._metric_point_buf: deque = deque()
+        self._metric_points_export_dropped = 0  # since last flush (shipped)
+        self._metric_points_dropped_total = 0   # lifetime (metrics)
+        # Telemetry self-audit: subsystem -> [wall seconds, approx bytes]
+        # accumulated in the export flush paths, re-exported as
+        # ray_tpu_internal_telemetry_flush_* series each metrics tick.
+        self._m_telemetry: Dict[str, list] = {}  # unguarded-ok: event thread + flush timers; float += races at worst lose one sample's accounting
         # in-flight live stack-dump gathers: token -> {want, procs, cb, done}
         self._stack_queries: Dict[str, dict] = {}
         self._stack_token_seq = itertools.count(1)
@@ -1557,6 +1569,10 @@ class Raylet:
             # worker folded-stack batch (continuous profiling) -> GCS
             # profile table on the next flush tick
             self._profile_ingest(msg["samples"], msg.get("dropped", 0))
+        elif t == "metric_points":
+            # worker metric delta-point batch (time-series export) -> GCS
+            # metrics table on the next internal-metrics tick
+            self._metric_points_ingest(msg["points"], msg.get("dropped", 0))
         elif t == "stack_reply":
             # a worker answered a live stack-dump request (ray_tpu stack)
             self._on_stack_reply(conn, msg)
@@ -5400,6 +5416,24 @@ class Raylet:
                 kw = {k: msg[k] for k in ("node_id", "since", "limit")
                       if k in msg}
                 reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
+            elif op == "flush_metric_points":
+                self.flush_metric_points()
+                reply()
+            elif op in ("query_metrics", "metrics_table_stats"):
+                # Cluster-wide time-series reads proxied to the GCS
+                # metrics table; flush so this node's freshest deltas
+                # count (other nodes' points land on their own 1s ticks).
+                self.flush_metric_points()
+                kw = {k: msg[k] for k in ("name", "query_op", "tags",
+                                          "node_id", "since", "until",
+                                          "window_s", "q", "limit")
+                      if k in msg}
+                if "query_op" in kw:
+                    kw["op"] = kw.pop("query_op")
+                reply(value=self._gcs_safe(getattr(self.gcs, op), **kw))
+            elif op == "list_alerts":
+                kw = {k: msg[k] for k in ("state", "limit") if k in msg}
+                reply(value=self._gcs_safe(self.gcs.list_alerts, **kw))
             elif op == "dump_stacks":
                 # this node only: raylet process + all local workers
                 self.collect_local_stacks(deferred_reply,
@@ -5794,6 +5828,7 @@ class Raylet:
             self._trace_ingest(local, dropped)
         if not self._trace_buf and not self._trace_export_dropped:
             return
+        t0 = time.perf_counter()
         spans = list(self._trace_buf)
         self._trace_buf.clear()
         dropped = self._trace_export_dropped
@@ -5812,6 +5847,7 @@ class Raylet:
             # reporting zero drops across an outage.
             self._trace_dropped_total += len(spans)
             self._trace_export_dropped += dropped + len(spans)
+        self._audit_flush("trace", t0, batch=spans)
 
     def _trace_flush_tick(self):
         # One-shot timer, armed lazily by the first ingest: an untraced
@@ -5852,6 +5888,7 @@ class Raylet:
             self._profile_ingest(local, dropped)
         if not self._profile_buf and not self._profile_export_dropped:
             return
+        t0 = time.perf_counter()
         samples = list(self._profile_buf)
         self._profile_buf.clear()
         dropped = self._profile_export_dropped
@@ -5867,6 +5904,7 @@ class Raylet:
             # GCS unreachable: the batch is gone — count it honestly
             self._profile_dropped_total += len(samples)
             self._profile_export_dropped += dropped + len(samples)
+        self._audit_flush("profile", t0, batch=samples)
 
     def _profile_flush_tick(self):
         # Recurring (unlike the lazily-armed trace timer): samples
@@ -5878,6 +5916,72 @@ class Raylet:
         self.flush_profile_samples()
         self.add_timer(config.profile_flush_interval_s,
                        self._profile_flush_tick)
+
+    # ---- metric time-series export (delta points -> GCS table) ----
+
+    def _metric_points_ingest(self, points: List[dict], dropped: int = 0):
+        """Append a delta-point batch (worker control frames / the local
+        registry ring / the raylet's own internal set) to the bounded
+        export buffer."""
+        buf = self._metric_point_buf
+        cap = config.metrics_history_ring
+        self._metric_points_export_dropped += dropped
+        self._metric_points_dropped_total += dropped
+        for p in points:
+            buf.append(p)
+            if len(buf) > cap:
+                buf.popleft()
+                self._metric_points_export_dropped += 1
+                self._metric_points_dropped_total += 1
+
+    def flush_metric_points(self):
+        """Drain this process's point ring plus everything workers have
+        shipped, and post the batch to the GCS metrics table."""
+        local, dropped = _metrics_mod.drain_points()
+        if local or dropped:
+            self._metric_points_ingest(local, dropped)
+        if not self._metric_point_buf and \
+                not self._metric_points_export_dropped:
+            return
+        t0 = time.perf_counter()
+        points = list(self._metric_point_buf)
+        self._metric_point_buf.clear()
+        dropped = self._metric_points_export_dropped
+        self._metric_points_export_dropped = 0
+        try:
+            if isinstance(self.gcs, GcsClient):
+                self.gcs.post("add_metric_points", self.node_id, points,
+                              dropped, incarnation=self.incarnation)
+            else:
+                self.gcs.add_metric_points(self.node_id, points, dropped,
+                                           incarnation=self.incarnation)
+        except (ConnectionError, TimeoutError, OSError):
+            # GCS unreachable: the batch is gone — count it honestly
+            self._metric_points_dropped_total += len(points)
+            self._metric_points_export_dropped += dropped + len(points)
+        self._audit_flush("metrics", t0, batch=points)
+
+    def _audit_flush(self, subsystem: str, t0: float,
+                     batch: Optional[list] = None, nbytes: float = 0.0):
+        """Telemetry self-audit: accumulate wall time and approximate
+        shipped bytes per export subsystem (task_events / trace / profile
+        / metrics), re-exported as ray_tpu_internal_telemetry_flush_*
+        counters.  Dict batches are costed as records x one sampled
+        record's JSON size — serializing the whole batch just to weigh it
+        would double the very cost being measured."""
+        import json as _json
+
+        slot = self._m_telemetry.get(subsystem)
+        if slot is None:
+            slot = self._m_telemetry[subsystem] = [0.0, 0.0]
+        slot[0] += time.perf_counter() - t0
+        if batch:
+            try:
+                rec = len(_json.dumps(batch[0], default=str))
+            except (TypeError, ValueError):
+                rec = 0
+            nbytes += rec * len(batch)
+        slot[1] += nbytes
 
     # ---- live introspection (stack dumps / targeted node queries) ----
 
@@ -6043,11 +6147,13 @@ class Raylet:
         this before querying so a just-finished task is visible."""
         if not self._task_event_buf and not self._task_event_dropped:
             return
+        t0 = time.perf_counter()
         events = list(self._task_event_buf)
         self._task_event_buf.clear()
         dropped, self._task_event_dropped = self._task_event_dropped, 0
         self._gcs_post("add_task_events", self.node_id, events, dropped,
                        incarnation=self.incarnation)
+        self._audit_flush("task_events", t0, batch=events)
 
     def _task_event_flush_tick(self):
         # One-shot timer, re-armed lazily by the next _record_event: an
@@ -6116,6 +6222,20 @@ class Raylet:
                 "ray_tpu_internal_profile_samples_dropped_total",
                 "Folded profile sample records shed by the export "
                 "buffers before reaching the GCS profile table"),
+            "metric_points_dropped": counter(
+                "ray_tpu_internal_metric_points_dropped_total",
+                "Metric time-series delta points shed by the export "
+                "rings before reaching the GCS metrics table"),
+            "telemetry_flush_s": counter(
+                "ray_tpu_internal_telemetry_flush_seconds_total",
+                "Telemetry self-audit: wall seconds spent in export "
+                "flush paths, by subsystem",
+                tag_keys=("node", "subsystem")),
+            "telemetry_flush_bytes": counter(
+                "ray_tpu_internal_telemetry_flush_bytes_total",
+                "Telemetry self-audit: approximate bytes shipped by "
+                "export flush paths, by subsystem",
+                tag_keys=("node", "subsystem")),
             "frames": counter(
                 "ray_tpu_internal_proto_frames_total",
                 "Control-plane frames handled"),
@@ -6223,6 +6343,8 @@ class Raylet:
                 "declared-dead incarnation)"),
         }
         self._im_producer = f"raylet-{os.getpid()}-{self.node_id[:8]}"
+        # time-series baselines for collect_points (metrics tick only)
+        self._im_points_last: Dict = {}
         if isinstance(self.gcs, GcsClient):
             self.gcs.rpc_observer = self._observe_gcs_rpc
 
@@ -6305,6 +6427,13 @@ class Raylet:
         bump(im["deadline_exceeded"], "deadline_exceeded",
              self._m_deadline_exceeded)
         bump(im["cancelled"], "cancelled", self._m_cancelled)
+        bump(im["metric_points_dropped"], "mpoints_dropped",
+             self._metric_points_dropped_total)
+        for sub, slot in self._m_telemetry.items():
+            bump(im["telemetry_flush_s"], f"tel_s_{sub}", slot[0],
+                 tags={"subsystem": sub})
+            bump(im["telemetry_flush_bytes"], f"tel_b_{sub}", slot[1],
+                 tags={"subsystem": sub})
         if self._pull_manager is not None:
             ps = self._pull_manager.stats()
             im["pull_inflight_bytes"].set(ps["inflight_bytes"])
@@ -6319,6 +6448,7 @@ class Raylet:
 
         import json as _json
 
+        t0 = time.perf_counter()
         items = []
         for m in im.values():
             payload = m._export()
@@ -6329,6 +6459,18 @@ class Raylet:
         if items:
             # one post for the whole metric set (~30 keys), not one per key
             self._gcs_post("kv_multi_put", "metrics", items)
+        self._audit_flush("metrics", t0,
+                          nbytes=sum(len(k) + len(v) for k, v in items))
+        if config.metrics_history:
+            # the same cadence ships DELTA points into the GCS metrics
+            # time-series table: this raylet's internal set, the local
+            # registry ring (driver-process user/serve metrics), and
+            # whatever workers shipped since the last tick
+            points = _metrics_mod.collect_points(im.values(),
+                                                 self._im_points_last)
+            if points:
+                self._metric_points_ingest(points)
+            self.flush_metric_points()
 
     def state_snapshot(self, objects_limit: int = 0) -> dict:
         return {

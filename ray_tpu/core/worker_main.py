@@ -1063,6 +1063,15 @@ def main():
         lambda samples, dropped: worker._send(
             {"t": "profile_samples", "samples": samples,
              "dropped": dropped}))
+    # metric time-series delta points ride the same route (raylet -> GCS
+    # metrics table); registered unconditionally — the per-process flusher
+    # only spins up once a metric is registered in this worker, and the
+    # flush itself checks the metrics_history flag
+    from ray_tpu.util import metrics as _metrics_mod
+
+    _metrics_mod.set_points_target(
+        lambda points, dropped: worker._send(
+            {"t": "metric_points", "points": points, "dropped": dropped}))
     while True:
         try:
             _main_tick(worker)

@@ -28,9 +28,16 @@ Endpoints:
                              flamegraph export
   GET /api/logs              per-worker log files per node (?node=);
                              ?node=<prefix>&file=<name>[&lines=N] tails
-  GET /metrics               Prometheus text exposition (system gauges +
-                             internal ray_tpu_internal_* incl. the
-                             GCS-side health series + user metrics)
+  GET /metrics               Prometheus/OpenMetrics text exposition
+                             (system gauges + internal ray_tpu_internal_*
+                             incl. the GCS-side health series + user
+                             metrics); ?format=json for the same series
+                             as a JSON document
+  GET /api/metrics_range     time-series reads over the GCS metrics table
+                             (?name=&op=range|rate|quantile|series&tags=
+                             k=v,...&node=&since=&until=&window=&q=&limit=)
+  GET /api/alerts            firing alerts + transition log from the GCS
+                             rule engine (?state=firing|resolved&limit=)
 """
 
 from __future__ import annotations
@@ -101,7 +108,16 @@ class DashboardHead:
         if path == "/":
             return self._index(), "text/html"
         if path == "/metrics":
+            if query.get("format") == "json":
+                return (json.dumps(self._metrics_json(), default=str),
+                        "application/json")
             return self._metrics(), "text/plain; version=0.0.4"
+        if path == "/api/metrics_range":
+            return (json.dumps(self._metrics_range(query), default=str),
+                    "application/json")
+        if path == "/api/alerts":
+            return (json.dumps(self._alerts(query), default=str),
+                    "application/json")
         if path == "/api/stacks":
             return (json.dumps(self._stacks(query), default=str),
                     "application/json")
@@ -277,7 +293,61 @@ class DashboardHead:
         return {nid: rep for nid, rep in out.get("reports", {}).items()
                 if isinstance(rep, list)}
 
+    def _metrics_range(self, query: dict):
+        """Time-series reads over the GCS metrics table: range dumps the
+        retained points, rate/quantile evaluate over ?window= seconds,
+        series summarizes every retained series."""
+        tags = None
+        if query.get("tags"):
+            tags = dict(kv.split("=", 1)
+                        for kv in query["tags"].split(",") if "=" in kv)
+        return self._gcs.query_metrics(
+            name=query.get("name"),
+            op=query.get("op", "range"),
+            tags=tags,
+            node_id=query.get("node"),
+            since=float(query["since"]) if "since" in query else None,
+            until=float(query["until"]) if "until" in query else None,
+            window_s=float(query.get("window", 60.0)),
+            q=float(query.get("q", 0.99)),
+            limit=int(query.get("limit", 2000)))
+
+    def _alerts(self, query: dict):
+        """Firing alerts + the recent firing/resolved transition log from
+        the GCS rule engine."""
+        return self._gcs.list_alerts(state=query.get("state"),
+                                     limit=int(query.get("limit", 100)))
+
     # ------------------------------------------------------------- metrics
+
+    def _system_gauges(self):
+        """The dashboard-computed cluster gauges (not in the metrics KV):
+        alive nodes, per-node resources, actor-state counts."""
+        nodes = self._gcs.nodes()
+        alive = [n for n in nodes if n["alive"]]
+        states: dict = {}
+        for a in self._gcs.list_actors():
+            st = a.get("state", "?")
+            states[st] = states.get(st, 0) + 1
+        return alive, states
+
+    def _metrics_json(self):
+        """The /metrics series as a JSON document (?format=json): system
+        gauges plus every merged producer family."""
+        from ray_tpu.util.metrics import kv_metrics_json, merge_kv_metrics
+
+        alive, states = self._system_gauges()
+        resources = [
+            {"node": n["node_id"][:12],
+             "total": n["resources_total"],
+             "available": n.get("resources_available", {})}
+            for n in alive]
+        return {
+            "nodes_alive": len(alive),
+            "resources": resources,
+            "actors": states,
+            "metrics": kv_metrics_json(merge_kv_metrics(self._gcs)),
+        }
 
     def _metrics(self) -> str:
         """Prometheus text exposition (reference: the per-node MetricsAgent
@@ -285,14 +355,19 @@ class DashboardHead:
         gauges from GCS state + any user metrics pushed to the GCS KV by
         ``ray_tpu.util.metrics``."""
         lines = []
-        nodes = self._gcs.nodes()
-        alive = [n for n in nodes if n["alive"]]
+        alive, states = self._system_gauges()
+        lines.append("# HELP ray_tpu_nodes_alive Alive raylets in the "
+                     "GCS node table.")
         lines.append("# TYPE ray_tpu_nodes_alive gauge")
         lines.append(f"ray_tpu_nodes_alive {len(alive)}")
+        lines.append("# HELP ray_tpu_resource_total Per-node declared "
+                     "resource capacity.")
         lines.append("# TYPE ray_tpu_resource_total gauge")
+        lines.append("# HELP ray_tpu_resource_available Per-node "
+                     "currently-unclaimed resources.")
         lines.append("# TYPE ray_tpu_resource_available gauge")
         for n in alive:
-            nid = n["node_id"][:12]
+            nid = _prom_escape(n["node_id"][:12])
             for k, v in n["resources_total"].items():
                 lines.append(
                     f'ray_tpu_resource_total{{node="{nid}",'
@@ -301,10 +376,9 @@ class DashboardHead:
                 lines.append(
                     f'ray_tpu_resource_available{{node="{nid}",'
                     f'resource="{_prom_escape(k)}"}} {v}')
+        lines.append("# HELP ray_tpu_actors Actor count per lifecycle "
+                     "state.")
         lines.append("# TYPE ray_tpu_actors gauge")
-        states: dict = {}
-        for a in self._gcs.list_actors():
-            states[a.get("state", "?")] = states.get(a.get("state", "?"), 0) + 1
         for st, count in sorted(states.items()):
             lines.append(f'ray_tpu_actors{{state="{_prom_escape(st)}"}} '
                          f'{count}')
@@ -348,7 +422,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 8px}}</style></head>
 <p>APIs: /api/nodes /api/actors /api/jobs /api/cluster_resources /api/load
 /api/placement_groups /api/tasks /api/task_summary /api/timeline
 /api/trace/&lt;id&gt; /api/trace_summary /api/health /api/stacks
-/api/profile /api/logs /metrics</p>
+/api/profile /api/logs /api/metrics_range /api/alerts /metrics</p>
 </body></html>"""
 
     def shutdown(self):

@@ -21,6 +21,10 @@ Commands:
                                                top-function table
   logs [file] --address ... [--follow]         list/tail per-worker log
                                                files (ray logs)
+  metrics {query,top} --address ...            metric time-series:
+                                               range/rate/quantile reads,
+                                               busiest-series table
+  alerts --address ... [--log]                 firing alerts + transitions
 """
 
 from __future__ import annotations
@@ -403,6 +407,80 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Metric time-series (GCS metrics table): ``query`` runs a range /
+    rate / quantile read over one series; ``top`` prints the busiest
+    series cluster-wide (rate-ranked summary)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    if args.action == "top":
+        out = state.query_metrics(op="series", window_s=args.window,
+                                  limit=args.limit)
+        series = (out or {}).get("series", [])[:args.top]
+        if not series:
+            print("no metric points retained", file=sys.stderr)
+            return 1
+        print(f"{'series':<64}{'kind':<11}{'rate/s':>10}{'value':>12}")
+        for row in series:
+            tags = ",".join(f"{k}={v}" for k, v in row.get("tags", []))
+            label = row["name"].replace("ray_tpu_internal_", "")
+            if tags:
+                label += "{" + tags + "}"
+            rate = row.get("rate")
+            val = row.get("value", row.get("total"))
+            p99 = row.get("p99")
+            extra = f"  p99={p99:.4f}" if p99 is not None else ""
+            print(f"{label[:62]:<64}{row['kind']:<11}"
+                  f"{(f'{rate:.2f}' if rate is not None else '-'):>10}"
+                  f"{(f'{val:.4g}' if val is not None else '-'):>12}"
+                  f"{extra}")
+        return 0
+    tags = dict(kv.split("=", 1) for kv in (args.tag or []))
+    out = state.query_metrics(
+        name=args.name, op=args.op, tags=tags or None, node_id=args.node,
+        since=args.since, until=args.until, window_s=args.window,
+        q=args.q, limit=args.limit)
+    if out is None:
+        print("error: no cluster (metrics table needs a GCS)",
+              file=sys.stderr)
+        return 1
+    if args.op == "range":
+        for p in out.get("points", []):
+            print(json.dumps(p, default=str))
+        if out.get("truncated"):
+            print(f"(truncated to {args.limit} points)", file=sys.stderr)
+    else:
+        print(json.dumps(out, default=str))
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """Alert table (GCS rule engine): firing alerts plus the recent
+    transition log (firing -> resolved)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    out = state.list_alerts(state=args.state, limit=args.limit)
+    if out is None:
+        print("error: no cluster (alerts need a GCS)", file=sys.stderr)
+        return 1
+    firing = out.get("firing", [])
+    print(f"firing: {len(firing)}  (log dropped: "
+          f"{out.get('num_dropped', 0)})")
+    for a in firing:
+        print(f"  [{a['severity']}] {a['rule']}  value={a['value']:.4g} "
+              f"threshold={a['threshold']:.4g}  since={a['since']:.1f}")
+        if a.get("summary"):
+            print(f"      {a['summary']}")
+    if args.log:
+        print("log (newest first):")
+        for a in out.get("log", []):
+            print(f"  {a['ts']:.1f} {a['state']:<9} [{a['severity']}] "
+                  f"{a['rule']}  value={a['value']:.4g}")
+    return 0
+
+
 def cmd_logs(args) -> int:
     """List / tail the per-worker log files each raylet writes under its
     ``session_dir/logs`` (reference: ``ray logs``).  With a file name the
@@ -584,6 +662,41 @@ def main(argv=None) -> int:
                    default="speedscope")
     p.add_argument("--out", default="profile.speedscope.json")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "metrics", help="metric time-series: range/rate/quantile query / "
+                        "busiest-series table")
+    p.add_argument("action", choices=["query", "top"])
+    p.add_argument("--address", required=True)
+    p.add_argument("--name", default=None,
+                   help="metric name (required for query)")
+    p.add_argument("--op", choices=["range", "rate", "quantile"],
+                   default="range")
+    p.add_argument("--tag", action="append", default=None,
+                   metavar="K=V", help="label filter (repeatable)")
+    p.add_argument("--node", default=None, help="node-id prefix filter")
+    p.add_argument("--since", type=float, default=None,
+                   help="unix time lower bound (exclusive)")
+    p.add_argument("--until", type=float, default=None,
+                   help="unix time upper bound (inclusive)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="window seconds for rate/quantile/top")
+    p.add_argument("--q", type=float, default=0.99,
+                   help="quantile for --op quantile (default 0.99)")
+    p.add_argument("--limit", type=int, default=2000)
+    p.add_argument("--top", type=int, default=30,
+                   help="rows for the top table")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "alerts", help="firing alerts + recent transitions (GCS rule "
+                       "engine)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--state", choices=["firing", "resolved"], default=None)
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--log", action="store_true",
+                   help="also print the transition log")
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser(
         "logs", help="list/tail per-worker log files (ray logs)")

@@ -50,6 +50,18 @@ class _Batcher:
                 except queue.Empty:
                     break
             self.batch_sizes.append(len(batch))
+            try:
+                from ray_tpu.serve.telemetry import (
+                    replica_identity,
+                    serve_metrics,
+                )
+
+                dep = replica_identity()["deployment"]
+                if dep:
+                    serve_metrics()["batch"].observe(
+                        float(len(batch)), tags={"deployment": dep})
+            except Exception:  # noqa: BLE001 — telemetry never fails a batch
+                pass
             owner = batch[0][0]
             requests = [req for _, req, _ in batch]
             try:

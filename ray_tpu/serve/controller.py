@@ -331,6 +331,7 @@ class ServeController:
                         # the replica enforces this by REJECTING beyond it
                         # (typed BackPressureError; router retries/sheds)
                         spec.get("max_ongoing_requests", 100),
+                        deployment_name=name, replica_name=actor_name,
                     )
                     r = _ReplicaState(actor_name, handle, uid)
                     r.ready_ref = handle.check_health.remote()

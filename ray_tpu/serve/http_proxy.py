@@ -41,6 +41,8 @@ class HTTPProxy:
                 pass
 
             def _dispatch(self, body: Optional[bytes]):
+                import time as _time
+
                 from urllib.parse import parse_qs
 
                 from ray_tpu.core.exceptions import (
@@ -56,6 +58,7 @@ class HTTPProxy:
                 if parse_qs(query).get("stream", ["0"])[0] == "1":
                     return self._dispatch_stream(body, model_id)
                 retry_after = None
+                t0 = _time.perf_counter()
                 try:
                     status, payload = proxy._handle(self.path, body, model_id)
                 except BackPressureError as e:
@@ -72,6 +75,8 @@ class HTTPProxy:
                 except Exception as e:  # noqa: BLE001
                     status, payload = 500, json.dumps(
                         {"error": str(e)}).encode()
+                proxy._observe(self.path, status,
+                               _time.perf_counter() - t0)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 if retry_after is not None:
@@ -85,16 +90,22 @@ class HTTPProxy:
                 """?stream=1: chunked NDJSON, one line per yielded item —
                 items flush as the replica produces them (streaming
                 generator returns underneath)."""
+                import time as _time
+
+                t0 = _time.perf_counter()
                 try:
                     items = proxy._handle_stream(self.path, body, model_id)
                     first = next(items, _SENTINEL)
                 except Exception as e:  # noqa: BLE001
+                    proxy._observe(self.path, 500,
+                                   _time.perf_counter() - t0)
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                proxy._observe(self.path, 200, _time.perf_counter() - t0)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -129,6 +140,21 @@ class HTTPProxy:
         self._thread.start()
 
     # ----------------------------------------------------------------
+
+    def _observe(self, path: str, status: int, seconds: float):
+        """Ingress series, labeled by MATCHED route (bounded cardinality
+        — arbitrary request paths never become label values)."""
+        try:
+            from ray_tpu.serve.telemetry import serve_metrics
+
+            match = self._match_route(path)
+            route = match[0] if match else "unmatched"
+            m = serve_metrics()
+            m["http_requests"].inc(
+                tags={"route": route, "status": str(status)})
+            m["http_latency"].observe(seconds, tags={"route": route})
+        except Exception:  # noqa: BLE001 — telemetry never fails a request
+            pass
 
     def _handle(self, path: str, body: Optional[bytes],
                 model_id: str = ""):

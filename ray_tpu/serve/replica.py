@@ -25,7 +25,8 @@ config.define("serve_backpressure", bool, True,
 class Replica:
     def __init__(self, deployment_def, init_args, init_kwargs,
                  user_config: Optional[dict] = None,
-                 max_ongoing_requests: int = 0):
+                 max_ongoing_requests: int = 0,
+                 deployment_name: str = "", replica_name: str = ""):
         import cloudpickle
 
         fn_or_class = cloudpickle.loads(deployment_def)
@@ -36,6 +37,13 @@ class Replica:
         self._max_ongoing = int(max_ongoing_requests or 0)
         self._lock = threading.Lock()
         self._start_time = time.time()
+        self._deployment = deployment_name
+        self._tags = {"deployment": deployment_name,
+                      "replica": replica_name}
+        if deployment_name:
+            from ray_tpu.serve.telemetry import set_replica_identity
+
+            set_replica_identity(deployment_name, replica_name)
         if isinstance(fn_or_class, type):
             self._callable = fn_or_class(*init_args, **(init_kwargs or {}))
         else:
@@ -61,6 +69,21 @@ class Replica:
                     f"{self._max_ongoing} ({self._ongoing} in flight)")
             self._ongoing += 1
             self._total += 1
+        self._observe_load()
+
+    def _observe_load(self):
+        """Per-replica load gauges: admitted in-flight count plus the
+        depth of any @serve.batch queues in this process (the only place
+        admitted-but-not-executing requests can park)."""
+        if not self._deployment:
+            return
+        from ray_tpu.serve import batching
+        from ray_tpu.serve.telemetry import serve_metrics
+
+        m = serve_metrics()
+        m["inflight"].set(float(self._ongoing), tags=self._tags)
+        depth = sum(b.queue.qsize() for b in batching._registry.values())
+        m["queue"].set(float(depth), tags=self._tags)
 
     def _chaos_user_call(self):
         """Slow-executor chaos seam INSIDE the admission-counted window
@@ -93,6 +116,7 @@ class Replica:
             _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
+            self._observe_load()
 
     def handle_request_stream(self, request: Any, method: str = "__call__",
                               multiplexed_model_id: str = ""):
@@ -124,11 +148,18 @@ class Replica:
                     if ttft_ctx is not None:
                         tracing.hop("serve.ttft", ttft_ctx, t0, time.time(),
                                     proc="worker", method=method)
+                    if self._deployment:
+                        from ray_tpu.serve.telemetry import serve_metrics
+
+                        serve_metrics()["ttft"].observe(
+                            time.time() - t0,
+                            tags={"deployment": self._deployment})
                 yield item
         finally:
             _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
+            self._observe_load()
 
     def multiplexed_model_ids(self) -> list:
         """Model ids currently loaded by any @multiplexed method on this

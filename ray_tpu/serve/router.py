@@ -367,11 +367,18 @@ class DeploymentHandle:
                 _model_affinity.popitem(last=False)
         return replica
 
-    def remote(self, request: Any = None, _replica: Any = None):
+    def remote(self, request: Any = None, _replica: Any = None,
+               _counted: bool = False):
         """Dispatch; returns an ObjectRef (resolve with ray_tpu.get), or an
         ObjectRefGenerator when the handle has ``stream=True``."""
+        from ray_tpu.serve.telemetry import serve_metrics
         from ray_tpu.util import tracing
 
+        if not _counted:
+            # offered-load series (call() already counted its request —
+            # including attempts that shed before ever dispatching)
+            serve_metrics()["requests"].inc(
+                tags={"deployment": self._deployment})
         if not tracing.tracing_enabled():
             return self._remote_inner(request, _replica)
         # router→replica hop: the serve request's root span (or a child,
@@ -466,13 +473,19 @@ class DeploymentHandle:
         ``.remote()`` callers observe those rejects at ``get()``."""
         import ray_tpu
         from ray_tpu.core.exceptions import BackPressureError
+        from ray_tpu.serve.telemetry import serve_metrics
         from ray_tpu.util.retry import BackoffPolicy
 
+        m = serve_metrics()
+        tags = {"deployment": self._deployment}
+        m["requests"].inc(tags=tags)
+        t_start = time.perf_counter()
         budget = max(0, config.serve_reject_retry_budget)
         backoff = BackoffPolicy(base_s=0.01, max_s=0.25)
         last: Optional[BackPressureError] = None
         for attempt in range(budget + 1):
             if attempt:
+                m["retries"].inc(tags=tags)
                 time.sleep(backoff.delay(attempt - 1))
             slot = self._acquire_slot()
             if slot is None:
@@ -480,15 +493,21 @@ class DeploymentHandle:
                     f"all replicas of {self._deployment!r} at "
                     f"max_ongoing_requests")
                 continue
+            m["admitted"].inc(tags=tags)
             try:
-                return ray_tpu.get(
-                    self.remote(request, _replica=slot or None),
+                result = ray_tpu.get(
+                    self.remote(request, _replica=slot or None,
+                                _counted=True),
                     timeout=timeout)
+                m["latency"].observe(time.perf_counter() - t_start,
+                                     tags=tags)
+                return result
             except BackPressureError as e:
                 last = e  # replica-side race (another router's traffic)
             finally:
                 if slot is not False:
                     self._release_slot(slot)
+        m["shed"].inc(tags=tags)
         raise BackPressureError(
             f"deployment {self._deployment!r} saturated: "
             f"{budget + 1} attempts all rejected ({last})")

@@ -105,6 +105,75 @@ def _profile_table_call(op: str, **kw):
     return w._request(op, **kw)
 
 
+def _metrics_table_call(op: str, **kw):
+    """Query the GCS metrics time-series table cluster-wide.  This
+    process's point ring and the connected raylet's export buffer are
+    flushed first so the freshest local deltas count; remote raylets
+    flush on their own cadence (``internal_metrics_interval_s``)."""
+    w = _worker()
+    if w.mode == "local":
+        return None
+    from ray_tpu.util import metrics as _metrics
+
+    if w.mode == "driver":
+        # driver + raylet share a process: record this process's deltas
+        # into the shared ring, which the raylet's flush drains itself
+        _metrics.record_points()
+        w.raylet.call(w.raylet.flush_metric_points).result()
+        if op == "flush_metric_points":
+            return None
+        if "query_op" in kw:
+            kw["op"] = kw.pop("query_op")
+        return getattr(w.raylet.gcs, op)(**kw)
+    # worker / client modes: ship this process's ring to the raylet,
+    # which flushes locally and proxies the read.  The query kind rides
+    # as query_op (the request frame's own "op" key is the table op);
+    # the raylet proxy maps it back.
+    _metrics.flush_points()
+    return w._request(op, **kw)
+
+
+# ----------------------------------------------------- metrics & alerts
+
+
+def query_metrics(name: Optional[str] = None, op: str = "range",
+                  tags: Optional[Dict[str, str]] = None,
+                  node_id: Optional[str] = None,
+                  since: Optional[float] = None,
+                  until: Optional[float] = None,
+                  window_s: float = 60.0, q: float = 0.99,
+                  limit: int = 2000) -> Optional[Dict[str, Any]]:
+    """Query the cluster metrics time-series table (timestamped DELTA
+    points shipped by every node on its flush cadence).
+
+    ``op``: ``range`` (the points), ``rate`` (per-second increase over
+    the trailing ``window_s``), ``quantile`` (histogram quantile ``q``
+    over the window — bucket deltas merged, never averaged percentiles),
+    or ``series`` (per-series activity summary).  Returns None in local
+    mode (no cluster, no table)."""
+    return _metrics_table_call("query_metrics", name=name, query_op=op,
+                               tags=tags, node_id=node_id, since=since,
+                               until=until, window_s=window_s, q=q,
+                               limit=limit)
+
+
+def metrics_table_stats() -> Optional[Dict[str, Any]]:
+    """Size/eviction accounting for the GCS metrics time-series table."""
+    return _metrics_table_call("metrics_table_stats")
+
+
+def list_alerts(state: Optional[str] = None,
+                limit: int = 100) -> Optional[Dict[str, Any]]:
+    """The alert table: currently-firing alerts plus the recent
+    firing/resolved transition log (``state`` filters the log)."""
+    w = _worker()
+    if w.mode == "local":
+        return None
+    if w.mode == "driver":
+        return w.raylet.gcs.list_alerts(state=state, limit=limit)
+    return w._request("list_alerts", state=state, limit=limit)
+
+
 # ------------------------------------------------------------- profiling
 
 

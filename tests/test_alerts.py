@@ -1,0 +1,247 @@
+"""Alert rule engine — pure-function tests over a synthetic point query.
+
+``evaluate_rules`` and the two evaluators take a query callback, so every
+firing/persist/resolve path is exercised without a cluster (the GCS wires
+the same functions to its metrics table on the health-monitor tick).
+"""
+
+import pytest
+
+from ray_tpu.core.config import config
+from ray_tpu.util import alerts
+
+
+def _pt(ts, value, kind="counter", bounds=None):
+    p = {"name": "m", "kind": kind, "tags": [], "ts": ts, "value": value}
+    if bounds is not None:
+        p["bounds"] = list(bounds)
+    return p
+
+
+def _query_from(table):
+    """QueryFn over {metric_name: [points]} honoring the since bound."""
+
+    def query(name, tags, since):
+        pts = table.get(name, [])
+        if since is not None:
+            pts = [p for p in pts if p["ts"] > since]
+        return sorted(pts, key=lambda p: p["ts"])
+
+    return query
+
+
+def _threshold_rule(**over):
+    rule = {"name": "r", "kind": "threshold", "metric": "m",
+            "agg": "rate", "window_s": 60.0, "op": ">", "threshold": 1.0,
+            "severity": "warn", "summary": "test rule"}
+    rule.update(over)
+    return rule
+
+
+def _burn_rule(**over):
+    rule = {"name": "b", "kind": "burn_rate", "bad": "bad",
+            "total": "total", "objective": 0.99, "short_s": 15.0,
+            "long_s": 120.0, "factor": 10.0, "severity": "critical"}
+    rule.update(over)
+    return rule
+
+
+# --------------------------------------------------------------------------
+# threshold evaluator
+
+
+def test_threshold_rate_fires_above_bound():
+    q = _query_from({"m": [_pt(95.0, 40.0), _pt(99.0, 40.0)]})
+    firing, value = alerts.eval_threshold(_threshold_rule(), q, now=100.0)
+    assert firing and value == pytest.approx(80.0 / 60.0)
+    # same points, higher bound: not firing but the value still reports
+    firing, value = alerts.eval_threshold(
+        _threshold_rule(threshold=2.0), q, now=100.0)
+    assert not firing and value == pytest.approx(80.0 / 60.0)
+
+
+def test_threshold_no_data_is_not_firing():
+    """Absence of telemetry never fires a threshold rule — that failure
+    mode belongs to the drop-counter rules."""
+    q = _query_from({})
+    for agg in ("rate", "sum", "last", "max", "p99"):
+        firing, value = alerts.eval_threshold(
+            _threshold_rule(agg=agg), q, now=100.0)
+        assert (firing, value) == (False, None), agg
+    # points outside the window are no data too
+    q = _query_from({"m": [_pt(10.0, 5.0)]})
+    firing, value = alerts.eval_threshold(_threshold_rule(), q, now=100.0)
+    assert (firing, value) == (False, None)
+
+
+def test_threshold_aggs_and_ops():
+    q = _query_from({"m": [_pt(98.0, 3.0), _pt(99.0, 7.0)]})
+    _, v = alerts.eval_threshold(_threshold_rule(agg="sum"), q, 100.0)
+    assert v == 10.0
+    _, v = alerts.eval_threshold(_threshold_rule(agg="last"), q, 100.0)
+    assert v == 7.0
+    _, v = alerts.eval_threshold(_threshold_rule(agg="max"), q, 100.0)
+    assert v == 7.0
+    firing, _ = alerts.eval_threshold(
+        _threshold_rule(agg="last", op="<=", threshold=7.0), q, 100.0)
+    assert firing
+    with pytest.raises(ValueError):
+        alerts.eval_threshold(_threshold_rule(agg="median"), q, 100.0)
+
+
+def test_threshold_p99_merges_histogram_deltas():
+    bounds = [0.1, 1.0]
+    q = _query_from({"m": [
+        _pt(98.0, [98, 0, 0, 4.9, 98], kind="histogram", bounds=bounds),
+        _pt(99.0, [0, 2, 0, 1.6, 2], kind="histogram", bounds=bounds),
+    ]})
+    firing, value = alerts.eval_threshold(
+        _threshold_rule(agg="p99", threshold=0.1), q, now=100.0)
+    assert firing and 0.1 < value <= 1.0
+
+
+# --------------------------------------------------------------------------
+# burn-rate evaluator
+
+
+def test_burn_rate_requires_both_windows():
+    """Sustained damage: a shed burst inside the short window alone must
+    NOT fire — the long window has to corroborate."""
+    # 50% shed ratio in the last 10s, but the long window holds 1000
+    # earlier good requests: long-window ratio ~= 0.0108 -> burn ~= 1.1
+    table = {
+        "bad": [_pt(95.0, 11.0)],
+        "total": [_pt(30.0, 1000.0), _pt(95.0, 22.0)],
+    }
+    firing, value = alerts.eval_burn_rate(_burn_rule(), _query_from(table),
+                                          now=100.0)
+    assert not firing
+    assert value == pytest.approx((11.0 / 1022.0) / 0.01)
+    # the same burst with a matching long-window history DOES fire
+    table["total"] = [_pt(30.0, 0.0), _pt(95.0, 22.0)]
+    firing, value = alerts.eval_burn_rate(_burn_rule(), _query_from(table),
+                                          now=100.0)
+    assert firing and value == pytest.approx(50.0)
+
+
+def test_burn_rate_zero_total_is_zero_burn():
+    firing, value = alerts.eval_burn_rate(_burn_rule(), _query_from({}),
+                                          now=100.0)
+    assert (firing, value) == (False, 0.0)
+    with pytest.raises(ValueError):
+        alerts.eval_burn_rate(_burn_rule(objective=1.0), _query_from({}),
+                              now=100.0)
+
+
+def test_burn_rate_short_window_drives_resolution():
+    """Once the burst stops, the short window goes clean well before the
+    long window does — min-burn across windows resolves promptly."""
+    table = {
+        "bad": [_pt(50.0, 50.0)],   # old burst, still in the long window
+        "total": [_pt(50.0, 50.0), _pt(99.0, 100.0)],  # healthy traffic now
+    }
+    firing, value = alerts.eval_burn_rate(_burn_rule(), _query_from(table),
+                                          now=100.0)
+    assert not firing and value == 0.0  # short window: zero bad
+
+
+# --------------------------------------------------------------------------
+# evaluate_rules: transitions
+
+
+def test_firing_persist_resolve_transitions():
+    table = {"m": [_pt(95.0, 600.0)]}
+    q = _query_from(table)
+    rule = _threshold_rule()
+    active = {}
+
+    recs = alerts.evaluate_rules([rule], q, 100.0, active)
+    assert [r["state"] for r in recs] == ["firing"]
+    assert recs[0]["rule"] == "r" and recs[0]["since"] == 100.0
+    assert recs[0]["severity"] == "warn" and recs[0]["threshold"] == 1.0
+    assert "r" in active
+
+    # still firing: live view refreshes, NO new log record
+    table["m"].append(_pt(101.0, 1200.0))
+    recs = alerts.evaluate_rules([rule], q, 102.0, active)
+    assert recs == []
+    assert active["r"]["ts"] == 102.0 and active["r"]["since"] == 100.0
+    assert active["r"]["value"] > 10.0
+
+    # condition clears: one resolved record, active empties
+    recs = alerts.evaluate_rules([rule], q, 200.0, active)
+    assert [r["state"] for r in recs] == ["resolved"]
+    assert recs[0]["since"] == 100.0 and recs[0]["ts"] == 200.0
+    assert active == {}
+    # and staying clear emits nothing
+    assert alerts.evaluate_rules([rule], q, 201.0, active) == []
+
+
+def test_broken_rule_skipped_not_fatal():
+    """One malformed rule must not silence the rest of the pass."""
+    table = {"m": [_pt(99.0, 600.0)]}
+    broken = _threshold_rule(name="broken", agg="median")
+    missing = {"name": "nometric", "kind": "threshold"}  # no metric key
+    good = _threshold_rule(name="good")
+    active = {}
+    recs = alerts.evaluate_rules([broken, missing, good],
+                                 _query_from(table), 100.0, active)
+    assert [r["rule"] for r in recs] == ["good"]
+    assert list(active) == ["good"]
+
+
+def test_burn_rule_through_evaluate_rules():
+    rule = _burn_rule()
+    table = {"bad": [_pt(99.0, 30.0)], "total": [_pt(99.0, 40.0)]}
+    active = {}
+    recs = alerts.evaluate_rules([rule], _query_from(table), 100.0, active)
+    assert recs[0]["kind"] == "burn_rate"
+    assert recs[0]["threshold"] == 10.0  # the factor
+    assert recs[0]["value"] == pytest.approx(75.0)
+
+
+# --------------------------------------------------------------------------
+# rule loading / config merge
+
+
+def test_default_rules_include_documented_set():
+    names = {r["name"] for r in alerts.default_rules()}
+    assert "serve_shed_burn" in names
+    assert "serve_p99_latency" in names
+    assert "metric_point_drops" in names
+    for r in alerts.default_rules():
+        assert r["kind"] in ("threshold", "burn_rate")
+        assert r.get("summary"), f"rule {r['name']} is undocumented"
+
+
+def test_load_rules_merges_config_overrides():
+    old_rules, old_defaults = config.alerts_rules, config.alerts_default_rules
+    try:
+        # override one default by name + add a new rule
+        config.alerts_rules = (
+            '[{"name": "serve_shed_burn", "kind": "burn_rate",'
+            ' "bad": "ray_tpu_internal_serve_shed_total",'
+            ' "total": "ray_tpu_internal_serve_requests_total",'
+            ' "factor": 99.0},'
+            ' {"name": "custom", "kind": "threshold", "metric": "m",'
+            ' "threshold": 5.0}]')
+        rules = {r["name"]: r for r in alerts.load_rules()}
+        assert rules["serve_shed_burn"]["factor"] == 99.0
+        assert rules["custom"]["threshold"] == 5.0
+        assert "serve_p99_latency" in rules  # untouched defaults remain
+
+        # defaults disabled: only the config list survives
+        config.alerts_default_rules = False
+        names = {r["name"] for r in alerts.load_rules()}
+        assert names == {"serve_shed_burn", "custom"}
+
+        # malformed JSON / non-list payloads are ignored, not fatal
+        config.alerts_default_rules = True
+        config.alerts_rules = "{not json"
+        assert {r["name"] for r in alerts.load_rules()} == \
+            {r["name"] for r in alerts.default_rules()}
+        config.alerts_rules = '{"name": "not-a-list"}'
+        assert len(alerts.load_rules()) == len(alerts.default_rules())
+    finally:
+        config.alerts_rules = old_rules
+        config.alerts_default_rules = old_defaults
