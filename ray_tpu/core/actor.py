@@ -26,6 +26,7 @@ from ray_tpu.core.task_spec import (
     TaskSpec,
 )
 from ray_tpu.core.worker import global_worker
+from ray_tpu.util.tracing import submit_with_span
 
 
 class ActorMethod:
@@ -86,8 +87,6 @@ class ActorHandle:
                                or self._method_groups.get(method_name)),
             deadline=deadline_from_opts(opts),
         )
-        from ray_tpu.util.tracing import submit_with_span
-
         refs = submit_with_span(worker, spec,
                                 actor_id=self._actor_id.hex())
         if streaming:
@@ -99,7 +98,14 @@ class ActorHandle:
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # Cache the bound ActorMethod on the instance: a submit burst
+        # probes the same method once per call, and __getattr__ only
+        # fires on lookup MISS — after this, attribute access is a plain
+        # dict hit instead of a fresh allocation per call.  (.options()
+        # still mints a new ActorMethod; the cached one is optionless.)
+        method = ActorMethod(self, name)
+        self.__dict__[name] = method
+        return method
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"

@@ -102,14 +102,21 @@ class ClientWorker(Worker):
         if _config.direct_calls:
             from ray_tpu.core.direct import DirectCallClient
 
+            # broker/lease round trips are bounded like the in-process
+            # driver's (.result(2.0)): a stalled raylet must cost the
+            # submit path one timeout and a relayed fallback, never a
+            # wedged burst
             self._direct = DirectCallClient(
                 self,
                 broker=lambda aid: self._request("direct_lookup",
-                                                 actor_id=aid),
+                                                 actor_id=aid,
+                                                 _wait_timeout=2.0),
                 resubmit=self._submit_relayed,
-                lease=lambda spec: self._request("direct_lease", spec=spec),
+                lease=lambda spec: self._request("direct_lease", spec=spec,
+                                                 _wait_timeout=2.0),
                 lease_release=lambda lid: self._request(
-                    "direct_lease_release", lease_id=lid),
+                    "direct_lease_release", lease_id=lid,
+                    _wait_timeout=2.0),
             )
 
     # Worker.get/put/wait/submit use _send/_request like worker mode does.

@@ -49,7 +49,8 @@ _PARSERS: Dict[type, Callable[[str], Any]] = {
 
 
 class _Flag:
-    __slots__ = ("name", "type", "default", "doc", "value", "live")
+    __slots__ = ("name", "type", "default", "doc", "value", "live",
+                 "_env", "_last_raw", "_last_val")
 
     def __init__(self, name, type_, default, doc, live=False):
         self.name = name
@@ -58,12 +59,18 @@ class _Flag:
         self.doc = doc
         self.live = live
         self.value = default
+        self._env = _ENV_PREFIX + name.upper()
+        # live-read memo: re-parse only when the raw env STRING changes
+        # (chaos/debug flags are read per task execution — the parse and
+        # the per-read string building were the cost, not the env get)
+        self._last_raw = None
+        self._last_val = None
         if not live:
             self.reload()
 
     @property
     def env_name(self) -> str:
-        return _ENV_PREFIX + self.name.upper()
+        return self._env
 
     def _parse(self, raw: str):
         # A malformed env value falls back to the current value instead of
@@ -87,31 +94,51 @@ class _Flag:
 
     def current(self):
         if self.live:
-            env = os.environ.get(self.env_name)
+            env = os.environ.get(self._env)
             if env is not None:
-                return self._parse(env)
+                if env != self._last_raw:
+                    self._last_val = self._parse(env)
+                    self._last_raw = env
+                return self._last_val
         return self.value
 
 
 class _Config:
+    # Non-live flag values are MATERIALIZED as plain instance attributes:
+    # ``config.foo`` is then an ordinary instance-dict hit instead of a
+    # ``__getattr__`` miss (the miss protocol costs ~1µs and the direct
+    # transport hot path reads a dozen flags per call).  Live flags are
+    # never materialized — they re-read the environment on every access
+    # via the ``__getattr__`` fallback.  Every mutation path (define /
+    # initialize / reload / attribute set) re-materializes.
+
     def __init__(self):
         self._flags: Dict[str, _Flag] = {}
 
     def define(self, name: str, type_: type, default, doc: str = "",
                live: bool = False):
-        self._flags[name] = _Flag(name, type_, default, doc, live=live)
+        flag = _Flag(name, type_, default, doc, live=live)
+        self._flags[name] = flag
+        if not live:
+            object.__setattr__(self, name, flag.value)
 
     def initialize(self, overrides: Dict[str, Any]):
         for k, v in overrides.items():
             if k in self._flags:
-                self._flags[k].value = self._flags[k].type(v)
+                flag = self._flags[k]
+                flag.value = flag.type(v)
+                if not flag.live:
+                    object.__setattr__(self, k, flag.value)
 
     def reload(self, *names: str):
         """Re-read environment overrides — all flags, or just ``names``.
         Lets tests (and ``chaos.configure_net``) apply ``setenv`` changes
         made after the defining module was imported."""
         for name in names or list(self._flags):
-            self._flags[name].reload()
+            flag = self._flags[name]
+            flag.reload()
+            if not flag.live:
+                object.__setattr__(self, name, flag.value)
 
     def to_dict(self) -> Dict[str, Any]:
         # Live flags are per-process identity (node id, session dir, ...):
@@ -123,6 +150,8 @@ class _Config:
         return json.dumps(self.to_dict())
 
     def __getattr__(self, name: str):
+        # only reached for LIVE flags (and genuinely unknown names) —
+        # non-live flags are materialized instance attributes
         flags = object.__getattribute__(self, "_flags")
         if name in flags:
             return flags[name].current()
@@ -132,7 +161,10 @@ class _Config:
         if name.startswith("_"):
             object.__setattr__(self, name, value)
         else:
-            self._flags[name].value = self._flags[name].type(value)
+            flag = self._flags[name]
+            flag.value = flag.type(value)
+            if not flag.live:
+                object.__setattr__(self, name, flag.value)
 
 
 config = _Config()

@@ -103,6 +103,33 @@ config.define("direct_pipeline_depth", int, 64,
               "buffers so a fire-and-forget burst ping-pongs smoothly "
               "instead of wedging in sendall, and bounds how many calls "
               "can need reconciling after a teardown.")
+config.define("direct_burst", bool, True,
+              "Coalesced direct burst mode: async actor calls and "
+              "fast-turnover lease-reused tasks pipeline over the direct "
+              "channel with a windowed ack (each dresult acks one slot; "
+              "submit() demuxes the socket past direct_burst_window in "
+              "flight) instead of draining the window and falling back "
+              "to the relayed path; outbound dcalls and callee-side "
+              "raylet notes coalesce into one batched frame per flush "
+              "window.  RAY_TPU_DIRECT_BURST=0 is the kill switch and "
+              "restores the pre-burst drain-and-relay behavior exactly.")
+config.define("direct_burst_window", int, 64,
+              "Burst-mode window W: max unacked direct calls in flight "
+              "per channel.  Past W the submitting thread advances the "
+              "window by demuxing results (no per-call round trip, no "
+              "relayed hand-back), bounding both sides' socket buffers "
+              "and the reconcile set after a teardown.  Default chosen "
+              "from the bench_core burst-depth sweep (throughput rises "
+              "with W up to the socket-buffer knee; 64 ≈ the plateau).")
+config.define("direct_lease_turnover_ms", float, 2.0,
+              "Lease channels pipeline a burst (instead of spreading the "
+              "fan-out over the pool) only once the channel's observed "
+              "per-call turnover EWMA sits below this many milliseconds: "
+              "sub-ms tasks gain more from pipelined submission than "
+              "from pool parallelism, while longer tasks keep the "
+              "serial-reuse + relayed-spread behavior.  The callee "
+              "stamps the turnover (decode→result) into each burst-mode "
+              "dresult.")
 config.define("direct_freeze_gate_s", float, 3.0,
               "Callee freeze detector: if the worker process observes a "
               "scheduling gap longer than this (SIGSTOP partition, VM "
@@ -141,7 +168,7 @@ class _DirectConn:
     """One accepted caller connection on the callee worker."""
 
     __slots__ = ("sock", "send_lock", "alive", "stale", "hello",
-                 "coalesce", "_out")
+                 "coalesce", "_out", "note_buf")
 
     def __init__(self, sock):
         self.sock = sock
@@ -156,6 +183,17 @@ class _DirectConn:
         # coalesce is flipped only by the conn thread itself.
         self.coalesce = False
         self._out: List[dict] = []  # conn-thread only
+        # Raylet-note coalescing (burst mode): direct_running/direct_done
+        # notes from this train's inline executions buffer here and ship
+        # as ONE direct_notes frame at train drain — one ref-event flush
+        # and one done-buffer lock per train instead of two per call.
+        self.note_buf: List[dict] = []  # conn-thread only
+
+    def flush_notes(self, worker):
+        if not self.note_buf:
+            return
+        notes, self.note_buf = self.note_buf, []
+        worker.queue_direct_notes(notes)
 
     def send_result(self, msg):
         if self.coalesce:
@@ -258,8 +296,11 @@ class DirectServer:
     # ---- dedup cache ----
 
     def remember(self, task_id, done: dict):
-        rec = {k: done.get(k) for k in ("ok", "inline", "stored", "sizes",
-                                        "contains", "error", "retryable")}
+        # Stored by reference, not copied: _deliver_result hands the done
+        # dict here and never mutates it afterwards (its wire sends copy
+        # first), and every reader (lookup / admit / reconcile_probe /
+        # the deferred answer below) copies before stamping t/task_id.
+        rec = done
         with self._dedup_lock:
             self._dedup[task_id] = rec
             self._inflight.discard(task_id)
@@ -375,15 +416,75 @@ class DirectServer:
                 time.sleep(0.005)
         return None
 
+    def _handle_call(self, conn: _DirectConn, msg: dict, trailing: bool):
+        """One dcall (possibly unpacked from a dburst frame): dedup-admit
+        and execute inline / enqueue.  ``trailing`` = more calls are
+        already decoded behind this one, so results (and burst-mode
+        raylet notes) coalesce into the train's batched flush."""
+        spec: TaskSpec = msg["spec"]
+        if self._conn_is_stale(conn) or conn.hello is None:
+            # frames possibly buffered across a freeze (or a
+            # caller skipping the handshake): refuse — the
+            # caller reconciles via the raylet path
+            conn.send_result({"t": "dresult",
+                              "task_id": spec.task_id,
+                              "ok": False, "rejected": True})
+            return
+        cached, busy = self.admit(spec.task_id)
+        if cached is not None:
+            # retried call whose first execution completed:
+            # re-send the recorded result, never re-execute
+            cached["t"] = "dresult"
+            cached["task_id"] = spec.task_id
+            conn.send_result(cached)
+            return
+        if busy:
+            # already queued/executing here (duplicate direct
+            # submission): refuse — the caller reconciles via
+            # the raylet, which defers on the same execution
+            conn.send_result({"t": "dresult",
+                              "task_id": spec.task_id,
+                              "ok": False, "rejected": True})
+            return
+        task_msg = {"t": "task", "spec": spec,
+                    "arg_values": msg.get("arg_values") or {},
+                    "direct_conn": conn}
+        worker = self._worker
+        if (worker.actor_loop is None
+                and worker.group_executors is None
+                and worker.actor_executor is None):
+            # Plain sync actor / leased pool worker: execute
+            # RIGHT HERE on the conn thread — the queue
+            # handoff to the main executor thread is a full
+            # scheduler wakeup of dead time per call.  The
+            # exec lock serializes against the main loop, so
+            # single-threaded execution semantics hold.
+            from ray_tpu.core import worker_main
+
+            # results coalesce while more calls are decoded
+            # and waiting (one sendall per burst train; the
+            # loop top flushes when the train drains)
+            task_msg["_inline"] = True
+            task_msg["_rx_t"] = time.time()
+            conn.coalesce = trailing
+            with worker.exec_lock:
+                worker_main.execute_task(worker, task_msg)
+        else:
+            # asyncio / concurrency-group actors: route
+            # through the main loop's dispatch logic
+            worker.task_queue.put(task_msg)
+
     def _conn_loop(self, conn: _DirectConn):
         reader = protocol.FrameReader(conn.sock)
         try:
             while True:
                 if not reader._pending:
                     # end of a decoded train: ship any coalesced results
-                    # before blocking for the next frame
+                    # (and buffered raylet notes) before blocking for the
+                    # next frame
                     conn.coalesce = False
                     conn.flush_results()
+                    conn.flush_notes(self._worker)
                 try:
                     msg = reader.recv_msg()
                 except (OSError, protocol.ProtocolError):
@@ -412,57 +513,26 @@ class DirectServer:
                     # (the reader thread delivers the async exception).
                     self._worker.cancel_registry.cancel(msg["task_id"])
                 elif t == "dcall":
-                    spec: TaskSpec = msg["spec"]
-                    if self._conn_is_stale(conn) or conn.hello is None:
-                        # frames possibly buffered across a freeze (or a
-                        # caller skipping the handshake): refuse — the
-                        # caller reconciles via the raylet path
-                        conn.send_result({"t": "dresult",
-                                          "task_id": spec.task_id,
-                                          "ok": False, "rejected": True})
-                        continue
-                    cached, busy = self.admit(spec.task_id)
-                    if cached is not None:
-                        # retried call whose first execution completed:
-                        # re-send the recorded result, never re-execute
-                        cached["t"] = "dresult"
-                        cached["task_id"] = spec.task_id
-                        conn.send_result(cached)
-                        continue
-                    if busy:
-                        # already queued/executing here (duplicate direct
-                        # submission): refuse — the caller reconciles via
-                        # the raylet, which defers on the same execution
-                        conn.send_result({"t": "dresult",
-                                          "task_id": spec.task_id,
-                                          "ok": False, "rejected": True})
-                        continue
-                    task_msg = {"t": "task", "spec": spec,
-                                "arg_values": msg.get("arg_values") or {},
-                                "direct_conn": conn}
-                    worker = self._worker
-                    if (worker.actor_loop is None
-                            and worker.group_executors is None
-                            and worker.actor_executor is None):
-                        # Plain sync actor / leased pool worker: execute
-                        # RIGHT HERE on the conn thread — the queue
-                        # handoff to the main executor thread is a full
-                        # scheduler wakeup of dead time per call.  The
-                        # exec lock serializes against the main loop, so
-                        # single-threaded execution semantics hold.
-                        from ray_tpu.core import worker_main
-
-                        # results coalesce while more calls are decoded
-                        # and waiting (one sendall per burst train; the
-                        # loop top flushes when the train drains)
-                        conn.coalesce = bool(reader._pending)
-                        with worker.exec_lock:
-                            worker_main.execute_task(worker, task_msg)
-                    else:
-                        # asyncio / concurrency-group actors: route
-                        # through the main loop's dispatch logic
-                        worker.task_queue.put(task_msg)
+                    self._handle_call(conn, msg, bool(reader._pending))
+                elif t == "dburst":
+                    # one coalesced flush window from the caller: unpack
+                    # in order; every call but the last has decoded work
+                    # behind it by construction
+                    calls = msg["calls"]
+                    last = len(calls) - 1
+                    for i, sub in enumerate(calls):
+                        if sub.get("t") == "dcancel":
+                            # a cancel queued ahead of its (still
+                            # unflushed) dcall rides the same frame
+                            self._worker.cancel_registry.cancel(
+                                sub["task_id"])
+                            continue
+                        self._handle_call(conn, sub,
+                                          i < last or bool(reader._pending))
         finally:
+            # notes record executions that HAPPENED — they must reach the
+            # raylet even when the caller hangs up mid-train
+            conn.flush_notes(self._worker)
             conn.alive = False
             try:
                 conn.sock.close()
@@ -494,14 +564,30 @@ class _Pending:
     """One in-flight direct call, resolved by the channel reader (result)
     or by teardown (fallback via the raylet path)."""
 
-    __slots__ = ("event", "spec", "ctx", "t_sent", "fallback")
+    __slots__ = ("event", "spec", "ctx", "t_sent", "fallback", "done")
 
     def __init__(self, spec: TaskSpec, ctx):
-        self.event = threading.Event()
+        # ``done`` is the resolution flag; ``event`` is allocated LAZILY,
+        # only when a second thread actually parks on this entry — the
+        # common burst case (one thread submits AND demuxes) never pays
+        # the three allocations inside threading.Event().  Writers set
+        # ``done`` BEFORE reading ``event``; a parking thread installs
+        # ``event`` under the channel lock and re-checks ``done`` after,
+        # so no wake-up can be lost (GIL-atomic attribute stores).
+        self.event: Optional[threading.Event] = None
+        self.done = False
         self.spec = spec
         self.ctx = ctx  # sampled trace ctx or None (unsampled fast path)
         self.t_sent = 0.0
         self.fallback = False
+
+    def resolve(self):
+        """Mark resolved and wake any parked waiter (done-then-event
+        order pairs with _await's install-then-recheck)."""
+        self.done = True
+        ev = self.event
+        if ev is not None:
+            ev.set()
 
 
 class _Channel:
@@ -528,8 +614,15 @@ class _Channel:
         self.lock = make_lock("direct.channel.state")
         self.send_lock = make_lock("direct.channel.send")
         self.recv_lock = make_lock("direct.channel.recv")
+        # Serializes sendbuf-swap + wire write: two racing flushes must
+        # hit the socket in swap order or per-handle FIFO breaks.
+        self.flush_lock = make_lock("direct.channel.flush")
         self.pending: "OrderedDict[Any, _Pending]" = OrderedDict()  # guard: lock
         self.alive = True  # guard: lock
+        # Observed per-call turnover (decode→result at the callee, EWMA
+        # seconds) — burst mode pipelines a lease channel only below
+        # direct_lease_turnover_ms (guard: lock)
+        self.turnover_ewma: Optional[float] = None
         # Outbound dcall frames awaiting coalesced flush (guard: lock):
         # a burst of submits ships as ONE sendall — flushed inline at 16,
         # by the first get()'s resolve, or by the manager's micro-flusher
@@ -586,20 +679,29 @@ class _Channel:
     def submit(self, spec: TaskSpec, ctx) -> bool:
         """Ship one call, or return False to hand it to the relayed path.
 
-        The direct channel is a LATENCY transport: past
-        direct_pipeline_depth in flight, a deep fire-and-forget burst is
-        caller-CPU-bound here (one thread pickling, sending, and
-        demuxing) while the relayed path pipelines that work on the
-        raylet thread — so the window is drained as an ordering barrier
-        and the burst handed back to the raylet, which out-runs us at
-        depth.  Re-engagement (all completions observed) restores the
-        direct path for the next call/response phase."""
-        cap = max(1, config.direct_pipeline_depth)
-        with self.lock:
-            over = self.alive and len(self.pending) >= cap
-        if over:
-            self._drain_all()
-            return False
+        Burst mode (default): past ``direct_burst_window`` unacked calls
+        the submitting thread demuxes the channel socket to advance the
+        ack window — each dresult acks one slot — so a deep
+        fire-and-forget burst pipelines over the one FIFO socket with
+        ≤W in flight and never falls back mid-burst.
+
+        Kill switch (RAY_TPU_DIRECT_BURST=0) restores the pre-burst
+        behavior exactly: the direct channel is a LATENCY transport, and
+        past direct_pipeline_depth in flight the window is drained as an
+        ordering barrier and the burst handed back to the raylet, which
+        out-runs a single submitting thread at depth.  Re-engagement
+        (all completions observed) restores the direct path for the next
+        call/response phase."""
+        if config.direct_burst:
+            if not self._advance_window(max(1, config.direct_burst_window)):
+                return False
+        else:
+            cap = max(1, config.direct_pipeline_depth)
+            with self.lock:
+                over = self.alive and len(self.pending) >= cap
+            if over:
+                self._drain_all()
+                return False
         entry = _Pending(spec, ctx)
         entry.t_sent = time.time()
         with self.lock:
@@ -609,7 +711,12 @@ class _Channel:
             depth = len(self.pending)
             self.last_used = time.monotonic()
             self.sendbuf.append({"t": "dcall", "spec": spec})
-            flush_now = depth == 1 or len(self.sendbuf) >= 16
+            # half-window flush matches _advance_window's half-window
+            # drain: a steady-state burst alternates one dburst frame of
+            # W/2 calls with one demux round of W/2 acks
+            flush_now = (depth == 1
+                         or len(self.sendbuf)
+                         >= max(1, config.direct_burst_window // 2))
         if flush_now:
             # an empty pipeline means a latency-sensitive caller (sync
             # call loop): put the frame on the wire NOW
@@ -636,15 +743,87 @@ class _Channel:
                 oldest = next(iter(self.pending.values()))
             self._await(oldest, None)
 
-    def flush(self):
+    def _advance_window(self, cap: int) -> bool:
+        """Windowed ack (burst mode): when ``cap`` calls are unacked,
+        demux the socket on this very thread — each dresult is the ack —
+        until the window is HALF empty, then resume submitting.  The
+        half-window hysteresis is what makes coalescing work: draining
+        just one slot per submit would interleave flush/demux with every
+        call and put one frame per call on the wire; draining to cap/2
+        lets the next cap/2 submits pile into the sendbuf and ship as a
+        single dburst frame, which in turn arrives at the callee as a
+        coalesced train (batched notes, batched result flush).  No
+        per-call round trip, no relayed hand-back.  False = the channel
+        died while advancing (the caller relays, and teardown has
+        already reconciled the window)."""
         with self.lock:
-            if not self.sendbuf:
-                return
-            out, self.sendbuf = self.sendbuf, []
+            if not self.alive:
+                return False
+            if len(self.pending) < cap:
+                return True
+        target = max(cap // 2, 1)
+        while True:
+            with self.lock:
+                if not self.alive:
+                    return False
+                if len(self.pending) < target:
+                    return True
+                oldest = next(iter(self.pending.values()))
+            self._await(oldest, None)
+
+    def poll(self):
+        """Opportunistic non-blocking demux: drain any dresults already
+        decoded or sitting in the kernel buffer, without waiting.  Lets
+        a fan-out loop that is still relaying (lease turnover unknown)
+        observe completions — and their dur stamps — so burst
+        pipelining can engage mid-loop."""
+        if not self.recv_lock.acquire(blocking=False):
+            return  # another thread is demuxing already
         try:
-            protocol.send_msgs(self.sock, out, self.send_lock)
-        except OSError:
-            self.teardown("send failed")  # reconciles every pending call
+            while True:
+                if not self._reader._pending:  # unguarded-ok: recv_lock IS held — manual try-acquire above, invisible to the lexical pass
+                    try:
+                        ready, _, _ = select.select([self.sock], [], [], 0)
+                    except (OSError, ValueError):
+                        return  # socket closed under us: teardown owns it
+                    if not ready:
+                        return
+                try:
+                    msg = self._reader.recv_msg()  # unguarded-ok: recv_lock IS held — manual try-acquire above, invisible to the lexical pass
+                except (OSError, protocol.ProtocolError):
+                    msg = None
+                if msg is None:
+                    self.teardown("connection closed")
+                    return
+                if not self._dispatch(msg):
+                    return
+        finally:
+            self.recv_lock.release()
+
+    def flush(self):
+        if not self.sendbuf:  # unguarded-ok: GIL-atomic emptiness peek; the locked re-check below decides
+            # fast path: _await/_advance_window call flush once per
+            # demuxed entry — a burst drain would otherwise pay two lock
+            # rounds per ack just to discover there is nothing to send
+            return
+        # flush_lock spans swap + write: a racing pair of flushes (micro-
+        # flusher vs. a get()'s _await) must reach the wire in swap order
+        # or per-handle FIFO breaks
+        with self.flush_lock:
+            with self.lock:
+                if not self.sendbuf:
+                    return
+                out, self.sendbuf = self.sendbuf, []
+            if len(out) > 1 and config.direct_burst:
+                # one dburst frame per flush window: pickling the specs
+                # together memoizes shared strings (function/module
+                # names, resource keys) across the burst instead of
+                # paying them per call
+                out = [{"t": "dburst", "calls": out}]
+            try:
+                protocol.send_msgs(self.sock, out, self.send_lock)
+            except OSError:
+                self.teardown("send failed")  # reconciles every pending call
 
     def idle(self) -> bool:
         with self.lock:
@@ -664,6 +843,13 @@ class _Channel:
         with self.lock:
             entry = self.pending.pop(msg["task_id"], None)
             self.last_used = time.monotonic()
+            dur = msg.get("dur")
+            if dur is not None:
+                # callee-stamped decode→result turnover: the evidence the
+                # lease-pipelining gate (_fast_turnover) runs on
+                ew = self.turnover_ewma
+                self.turnover_ewma = dur if ew is None \
+                    else ew * 0.8 + dur * 0.2
         if entry is None:
             return True
         spec = entry.spec
@@ -679,7 +865,7 @@ class _Channel:
             for oid in spec.return_ids():
                 results[oid.hex()] = ("error", err)
         mgr._store_results(results)
-        entry.event.set()
+        entry.resolve()
         mgr._release_inner_refs(spec)
         if entry.ctx is not None:
             from ray_tpu.util import tracing
@@ -699,16 +885,27 @@ class _Channel:
         from ray_tpu.core.exceptions import GetTimeoutError
 
         self.flush()  # anything still coalescing must be on the wire
-        while not entry.event.is_set():
+        while not entry.done:
             if deadline is not None and time.monotonic() >= deadline:
                 raise GetTimeoutError(
                     "get() timed out waiting on a direct call")
             if not self.recv_lock.acquire(blocking=False):
-                # someone else demuxes; they will set our event
-                entry.event.wait(0.02)
+                # someone else demuxes; they will wake us.  Install the
+                # entry's (lazy) event first, then re-check done — the
+                # resolver sets done BEFORE reading event, so this order
+                # cannot miss the wake-up.
+                ev = entry.event
+                if ev is None:
+                    with self.lock:
+                        ev = entry.event
+                        if ev is None:
+                            entry.event = ev = threading.Event()
+                    if entry.done:
+                        continue
+                ev.wait(0.02)
                 continue
             try:
-                while not entry.event.is_set():
+                while not entry.done:
                     if not self._reader._pending:  # unguarded-ok: recv_lock IS held — manual try-acquire above, invisible to the lexical pass
                         # only hit the kernel when the reader's decoded
                         # backlog is empty — a chunked recv decodes many
@@ -791,7 +988,13 @@ class _Channel:
                 mgr._resubmit(spec)
             except Exception:  # noqa: BLE001 — shutdown races
                 pass
-            entry.event.set()
+            # the reconcile rides the relayed path: arm the engagement
+            # watermark so a re-dialed channel (same or bumped
+            # generation) cannot overtake a partially-acked window —
+            # the direct path re-engages only after these are observed
+            # delivered (no-op for lease/normal specs)
+            mgr._note_relayed(spec)
+            entry.resolve()
             mgr._release_inner_refs(spec)
 
 
@@ -940,16 +1143,28 @@ class DirectCallClient:
             ch = self._maybe_lease(key, spec)
             if ch is None:
                 return False
-        # Serial reuse only: a fan-out must spread over the pool, not
-        # serialize onto one leased worker — the lease accelerates
+        # Serial reuse by default: a fan-out must spread over the pool,
+        # not serialize onto one leased worker — the lease accelerates
         # call→result→call loops, the raylet keeps everything parallel.
+        # Burst mode pipelines PROVEN fast-turnover channels (EWMA below
+        # direct_lease_turnover_ms, stamped by the callee per dresult):
+        # sub-ms tasks gain more from pipelined submission than from
+        # per-task raylet dispatch, while unknown or slow channels keep
+        # the spread.
         if not ch.idle():
-            return False
+            if not (config.direct_burst and self._fast_turnover(ch)):
+                ch.poll()  # gather turnover evidence without blocking
+                return False
         self._pin_inner_refs(spec)
         if ch.submit(spec, _trace_ctx(spec)):
             return True
         self._release_inner_refs(spec)
         return False
+
+    def _fast_turnover(self, ch: _Channel) -> bool:
+        ew = ch.turnover_ewma  # unguarded-ok: GIL-atomic read; staleness costs at most one relayed call
+        return (ew is not None
+                and ew * 1000.0 <= config.direct_lease_turnover_ms)
 
     def _maybe_lease(self, key, spec: TaskSpec) -> Optional[_Channel]:
         now = time.monotonic()
@@ -1018,7 +1233,11 @@ class DirectCallClient:
                     threading.Thread(target=self._send_flush_loop,
                                      name="direct-send-flush",
                                      daemon=True).start()
-        self._flush_event.set()
+        ev = self._flush_event
+        if not ev.is_set():
+            # skip the condition-variable round when already armed — a
+            # burst re-arms once per flusher wake-up, not once per submit
+            ev.set()
 
     def _send_flush_loop(self):
         while not self._closed:
@@ -1046,19 +1265,17 @@ class DirectCallClient:
         later release by this process)."""
         if not spec.inner_refs:
             return
-        from ray_tpu.core.worker import note_ref_created
+        from ray_tpu.core.worker import note_refs_created
 
-        for oid in spec.inner_refs:
-            note_ref_created(oid)
+        note_refs_created(spec.inner_refs)  # one lock round per submit
 
     def _release_inner_refs(self, spec: TaskSpec):
         if not spec.inner_refs or getattr(spec, "_inner_released", False):
             return
         spec._inner_released = True
-        from ray_tpu.core.worker import note_ref_dropped
+        from ray_tpu.core.worker import note_refs_dropped
 
-        for oid in spec.inner_refs:
-            note_ref_dropped(oid)
+        note_refs_dropped(spec.inner_refs)
 
     def _store_results(self, results: Dict[str, tuple]):
         with self._lock:
@@ -1120,8 +1337,13 @@ class DirectCallClient:
         if not self._channels and not self._results:  # unguarded-ok: GIL-atomic emptiness probes (fast path for non-direct gets)
             return None
         h = oid.hex()
+        # pop, don't peek: a delivered result is consumed exactly once
+        # (a re-get falls back to the raylet path, where the callee's
+        # direct_done already registered it) — otherwise a burst larger
+        # than direct_result_cache evicts results the caller has not
+        # read yet and every evictee pays a raylet round trip
         with self._lock:
-            r = self._results.get(h)
+            r = self._results.pop(h, None)
         if r is not None:
             return r
         tid = oid.task_id()
@@ -1136,7 +1358,7 @@ class DirectCallClient:
             return None
         owner._await(entry, deadline)  # this thread demuxes the socket
         with self._lock:
-            return self._results.get(h)  # None => reconciled via raylet
+            return self._results.pop(h, None)  # None => reconciled via raylet
 
     # ------------------------------------------------------------- cancel
 
@@ -1149,10 +1371,24 @@ class DirectCallClient:
         when a channel had the call in flight."""
         tid = oid.task_id()
         for ch in list(self._channels.values()):  # unguarded-ok: snapshot; a racing teardown reconciles the call anyway
+            queued = False
             with ch.lock:
                 if tid not in ch.pending or not ch.alive:
                     continue
+                for i, frame in enumerate(ch.sendbuf):
+                    if frame.get("t") == "dcall" \
+                            and frame["spec"].task_id == tid:
+                        # the dcall is still coalescing in the burst
+                        # buffer: queue the cancel IN FRONT of it, so the
+                        # callee's registry marks the task before its
+                        # pre-exec check ever runs
+                        ch.sendbuf.insert(i, {"t": "dcancel",
+                                              "task_id": tid})
+                        queued = True
+                        break
             ch.flush()  # the dcall itself must not sit behind the cancel
+            if queued:
+                return True
             try:
                 protocol.send_msg(ch.sock, {"t": "dcancel", "task_id": tid},
                                   ch.send_lock)
@@ -1204,6 +1440,6 @@ class DirectCallClient:
             except OSError:
                 pass
             for entry in drain:
-                entry.event.set()
+                entry.resolve()
             if ch.lease_id is not None:
                 self._release_lease(ch)

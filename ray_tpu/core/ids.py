@@ -54,7 +54,7 @@ def _random_bytes(n: int) -> bytes:
 
 
 class BaseID:
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash", "_hex")
     SIZE = _UNIQUE_ID_SIZE
 
     def __init__(self, binary: bytes):
@@ -63,6 +63,8 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
             )
         self._bytes = binary
+        self._hash = None  # computed lazily; ids key hot-path dicts
+        self._hex = None  # ditto; task events / object tables key by hex
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -83,10 +85,16 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bytes.hex()
+        return h
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

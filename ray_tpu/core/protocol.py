@@ -25,6 +25,9 @@ worker->driver:
   done          {task_id, ok, inline: {hex: bytes}, stored: [hex], error}
   direct_done   done + {spec} — bookkeeping for a call whose result already
                 reached the caller over a direct channel
+  direct_notes  {notes: [direct_running|direct_done, ...]} — one coalesced
+                train of direct bookkeeping notes (burst mode), applied in
+                order raylet-side
   submit        {spec}                                       nested submission
   request       {rid, op, ...}  ops: get / wait / put_inline / kv_get / kv_put /
                 actor_handle / named_actor / submit_sync / log /
@@ -40,7 +43,15 @@ raylet is NOT on this path; it only brokered the address):
   dhello        {caller, actor_id|None, generation, incarnation, lease_id}
   dhello_ack    {ok, reason, pid}      generation/incarnation fencing verdict
   dcall         {spec}                 FIFO per channel; dep-free specs only
-  dresult       {task_id, ok, inline, stored, sizes, error, rejected?}
+  dburst        {calls: [dcall|dcancel, ...]}  one coalesced submit flush
+                window (burst mode) — pickled as a single frame so shared
+                spec strings are memoized across the burst; unpacked in
+                order at the callee
+  dresult       {task_id, ok, inline, stored, sizes, error, rejected?,
+                 dur?}  dur = callee decode→result turnover (burst mode),
+                the caller's lease-pipelining evidence
+  dcancel       {task_id}              cancel a call submitted on this
+                channel (pre-exec mark / mid-exec interrupt)
 
 Codec layer: framing (scan on receive, coalesced assembly on send) is a
 pluggable codec.  The default is a native library

@@ -1466,29 +1466,28 @@ class Raylet:
             self._on_direct_done(conn, msg)
             return
         if t == "direct_running":
-            # in-flight visibility for direct calls (timeline/state API);
-            # the dispatch itself never touched this raylet.  Also the
-            # cancel/deadline seam for direct work: record who executes it
-            # (cancel frames route to that worker's control socket) and
-            # its fan-out edge (nested submits reap with their parent).
-            spec = msg["spec"]
-            self._record_event(spec, "RUNNING", direct=True,
-                               pid=conn.pid)
-            self._note_child(spec)
-            self._direct_running[spec.task_id] = (conn, spec)
-            if len(self._direct_running) > 8192:  # missed dones: age out
-                self._direct_running.pop(next(iter(self._direct_running)))
-            flag = self._cancelled_flag(spec)
-            if flag is not None:
-                # the note raced a cancel/deadline fan-out that already
-                # walked the children index: reap it now that we know who
-                # executes it
-                self._note_cancelled(spec.task_id, flag)
-                try:
-                    conn.send({"t": "cancel", "task_id": spec.task_id,
-                               "deadline": flag})
-                except OSError:
-                    self._on_worker_death(conn)
+            self._on_direct_running(conn, msg)
+            return
+        if t == "direct_notes":
+            # one coalesced train of direct_running/direct_done notes
+            # (burst mode): apply in order — per-note bookkeeping matches
+            # the unbatched frames, the batch just amortizes the
+            # socket/dispatch cost across the callee's drained train.
+            # Coalesced-pair elision: a call whose RUNNING and DONE notes
+            # ride the SAME train already finished — its RUNNING note
+            # would only arm the cancel seam (moot) and a timeline row
+            # the FINISHED event supersedes, so skip it.  This halves
+            # the event-thread work per burst call; with the kill switch
+            # off notes arrive unbatched and keep full RUNNING fidelity.
+            notes = msg["notes"]
+            done_ids = {note["spec"].task_id for note in notes
+                        if note.get("t") != "direct_running"}
+            for note in notes:
+                if note.get("t") == "direct_running":
+                    if note["spec"].task_id not in done_ids:
+                        self._on_direct_running(conn, note)
+                else:
+                    self._on_direct_done(conn, note)
             return
         if t == "ping":
             # Liveness probe (GCS direct probe, or a peer relaying an
@@ -1782,6 +1781,31 @@ class Raylet:
             except OSError:
                 pass
 
+    def _on_direct_running(self, conn: _WorkerConn, msg: dict):
+        """In-flight visibility for direct calls (timeline/state API);
+        the dispatch itself never touched this raylet.  Also the
+        cancel/deadline seam for direct work: record who executes it
+        (cancel frames route to that worker's control socket) and its
+        fan-out edge (nested submits reap with their parent)."""
+        spec = msg["spec"]
+        self._record_event(spec, "RUNNING", direct=True,
+                           pid=conn.pid)
+        self._note_child(spec)
+        self._direct_running[spec.task_id] = (conn, spec)
+        if len(self._direct_running) > 8192:  # missed dones: age out
+            self._direct_running.pop(next(iter(self._direct_running)))
+        flag = self._cancelled_flag(spec)
+        if flag is not None:
+            # the note raced a cancel/deadline fan-out that already
+            # walked the children index: reap it now that we know who
+            # executes it
+            self._note_cancelled(spec.task_id, flag)
+            try:
+                conn.send({"t": "cancel", "task_id": spec.task_id,
+                           "deadline": flag})
+            except OSError:
+                self._on_worker_death(conn)
+
     def _on_direct_done(self, conn: Optional[_WorkerConn], msg: dict):
         spec: TaskSpec = msg["spec"]
         self._m_direct_dones += 1
@@ -1855,7 +1879,15 @@ class Raylet:
                     self._object_in_store(oid, contains=contains.get(h))
                     self._maybe_replicate(oid, force=spec.replicate,
                                           trace_ctx=spec.trace_ctx)
-            self._record_event(spec, "FINISHED", direct=True)
+            dur = msg.get("dur")
+            if dur is not None:
+                # callee-stamped exec duration: keeps timeline latency
+                # visible even when the paired RUNNING note was elided
+                # by the coalesced-train fast path
+                self._record_event(spec, "FINISHED", direct=True,
+                                   exec_s=dur)
+            else:
+                self._record_event(spec, "FINISHED", direct=True)
         else:
             err = msg.get("error")
             for oid in spec.return_ids():

@@ -10,6 +10,7 @@ from ray_tpu.core.config import config
 from ray_tpu.core.ids import TaskID
 from ray_tpu.core.task_spec import NORMAL_TASK, TaskSpec
 from ray_tpu.core.worker import global_worker
+from ray_tpu.util.tracing import submit_with_span
 
 
 def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
@@ -66,6 +67,12 @@ class RemoteFunction:
     def __init__(self, function, **options):
         self._function = function
         self._options = options
+        # Resources are a pure function of the (immutable) options:
+        # build once and share the SAME dict across every spec this
+        # function submits — nobody mutates spec.resources, and within
+        # one dburst frame the pickler memoizes the shared dict so a
+        # burst pays its serialization once instead of per call.
+        self._resources = _build_resources(options)
         self.__name__ = getattr(function, "__name__", "remote_fn")
         self.__doc__ = getattr(function, "__doc__", None)
 
@@ -99,7 +106,8 @@ class RemoteFunction:
             kwargs=out_kwargs,
             inner_refs=inner_refs or None,
             num_returns=num_returns,
-            resources=_build_resources(opts),
+            resources=(self._resources if opts is self._options
+                       else _build_resources(opts)),
             max_retries=max_retries,
             retries_left=max_retries,
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
@@ -108,8 +116,6 @@ class RemoteFunction:
             placement=_placement_from_opts(opts),
             deadline=deadline_from_opts(opts),
         )
-        from ray_tpu.util.tracing import submit_with_span
-
         refs = submit_with_span(worker, spec)
         if streaming:
             from ray_tpu.core.object_ref import ObjectRefGenerator

@@ -96,7 +96,17 @@ def _device_to_host(obj: Any) -> Any:
     return obj
 
 
+# Exact types whose pickle-5 stream is identical under stdlib pickle and
+# cloudpickle, never triggers the out-of-band buffer callback, and needs
+# no device-to-host conversion: the C ``pickle.dumps`` skips cloudpickle's
+# per-call Pickler construction (~10µs), which dominates serializing the
+# small scalar results the direct-transport hot path returns.
+_FAST_TYPES = frozenset((bytes, str, int, float, bool, type(None)))
+
+
 def serialize(obj: Any) -> SerializedObject:
+    if type(obj) in _FAST_TYPES:
+        return SerializedObject(pickle.dumps(obj, protocol=5), [])
     buffers: List[memoryview] = []
 
     def callback(pb: pickle.PickleBuffer) -> bool:
@@ -121,6 +131,10 @@ def serialize_with_refs(obj: Any):
     """serialize() + the ObjectIDs of every ObjectRef pickled inside the
     value — callers pin those ids for the serialized bytes' lifetime (the
     borrow-pinning protocol; see object_ref.collect_serialized_refs)."""
+    if type(obj) in _FAST_TYPES:
+        # no ObjectRef can hide inside a scalar/bytes value: skip the
+        # collector context (a contextvar round per result otherwise)
+        return serialize(obj), []
     from ray_tpu.core.object_ref import collect_serialized_refs
 
     with collect_serialized_refs() as c:

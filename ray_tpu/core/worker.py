@@ -92,6 +92,42 @@ def note_ref_dropped(oid):
         _flush_events(flush)
 
 
+def note_refs_created(oids):
+    """Bulk pin: one lock round for a whole arg list (the direct burst
+    path pins every inner ref of a submit under a single acquisition
+    instead of one per oid)."""
+    flush = None
+    with _ref_lock:
+        for oid in oids:
+            n = _ref_counts.get(oid, 0)
+            _ref_counts[oid] = n + 1
+            if n == 0:
+                _pending_events.append(("h", oid))
+        if len(_pending_events) >= _REF_EVENT_BATCH:
+            flush = list(_pending_events)
+            _pending_events.clear()
+    if flush is not None:
+        _flush_events(flush)
+
+
+def note_refs_dropped(oids):
+    """Bulk release — the counterpart of :func:`note_refs_created`."""
+    flush = None
+    with _ref_lock:
+        for oid in oids:
+            n = _ref_counts.get(oid, 0) - 1
+            if n > 0:
+                _ref_counts[oid] = n
+                continue
+            _ref_counts.pop(oid, None)
+            _pending_events.append(("r", oid))
+        if len(_pending_events) >= _REF_EVENT_BATCH:
+            flush = list(_pending_events)
+            _pending_events.clear()
+    if flush is not None:
+        _flush_events(flush)
+
+
 def flush_pending_releases():
     with _ref_lock:
         flush = list(_pending_events)
@@ -187,21 +223,23 @@ class Worker:
         `is` checks immune to id reuse).  In-place mutation of a captured
         object's internals remains export-once, matching the reference's
         function manager semantics."""
+        memo = self._fn_memo.get(id(callable_obj))
+        if memo is not None and memo[0] is callable_obj:
+            # memo-hit fast path: fingerprint against the LIVE attribute
+            # dict without snapshotting it — the copy below only happens
+            # on miss/re-pickle (the hit path runs once per .remote()
+            # and the per-call dict copy was ~5% of burst submit cost)
+            sd, sdef, scode = memo[3]
+            cur = getattr(callable_obj, "__dict__", None) or {}
+            if (getattr(callable_obj, "__defaults__", None) is sdef
+                    and getattr(callable_obj, "__code__", None) is scode
+                    and cur.keys() == sd.keys()
+                    and all(sd[k] is cur[k] for k in sd)):
+                self._fn_memo.move_to_end(id(callable_obj))
+                return memo[1], memo[2]
         fp = (dict(getattr(callable_obj, "__dict__", None) or {}),
               getattr(callable_obj, "__defaults__", None),
               getattr(callable_obj, "__code__", None))
-
-        def _fp_same(a, b):
-            da, db = a[0], b[0]
-            return (a[1] is b[1] and a[2] is b[2]
-                    and da.keys() == db.keys()
-                    and all(da[k] is db[k] for k in da))
-
-        memo = self._fn_memo.get(id(callable_obj))
-        if (memo is not None and memo[0] is callable_obj
-                and _fp_same(memo[3], fp)):
-            self._fn_memo.move_to_end(id(callable_obj))
-            return memo[1], memo[2]
         blob = cloudpickle.dumps(callable_obj)
         fid = FunctionID(hashlib.sha1(blob).digest()[:16])
         if len(blob) <= config.inline_object_max_bytes:

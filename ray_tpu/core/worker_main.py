@@ -50,6 +50,29 @@ from ray_tpu.util.locks import make_lock
 CONTROL_ERRORS = (BackPressureError, DeadlineExceededError,
                   TaskCancelledError)
 
+#: Hot-path module refs, resolved once on first execution.  The execute
+#: path used to run half a dozen ``from x import y`` statements PER CALL
+#: (~15µs of sys.modules lookups); those imports are deferred only to
+#: break import cycles at module-load time, so a lazy singleton pays the
+#: deferral exactly once.
+_HOT = None
+
+
+def _hot():
+    global _HOT
+    if _HOT is None:
+        from ray_tpu.core import runtime_env
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.runtime_context import (
+            _current_deadline,
+            _current_task_id,
+        )
+        from ray_tpu.util import chaos, profiling, tracing
+
+        _HOT = (_current_deadline, _current_task_id, chaos, profiling,
+                tracing, runtime_env, global_worker)
+    return _HOT
+
 
 def _async_raise(thread_ident: int, exc_type) -> bool:
     """Raise ``exc_type`` asynchronously in another thread (delivered at
@@ -120,6 +143,8 @@ class _CancelRegistry:
 
     def check(self, task_id):
         """Pre-exec seam: raise if this task was cancelled before it ran."""
+        if not self._cancelled:  # unguarded-ok: GIL-atomic emptiness peek; a cancel landing this instant is the same race as it landing one call later
+            return
         with self._lock:
             exc = self._cancelled.get(task_id)
         if exc is not None:
@@ -362,6 +387,18 @@ class RemoteWorker(Worker):
             self._done_buf.append(msg)
             self._done_pending.set()
 
+    def queue_direct_notes(self, notes):
+        """Buffer a whole drained train of direct_running/direct_done
+        notes as ONE direct_notes frame (burst mode): one ref-event
+        flush and one done-buffer lock round per train instead of two
+        per call — the raylet unpacks and applies them in order."""
+        from ray_tpu.core.worker import flush_pending_releases
+
+        flush_pending_releases()  # hold events precede the dones (in order)
+        with self._done_lock:
+            self._done_buf.append({"t": "direct_notes", "notes": notes})
+            self._done_pending.set()
+
     def requeue_pending_tasks(self):
         """Hand unstarted batched tasks back to the raylet — called before
         blocking (nested get/wait): the current task may wait on work that
@@ -440,11 +477,27 @@ def _deliver_result(worker: RemoteWorker, msg: dict, done: dict):
     worker.direct_server.remember(spec.task_id, done)
     res = dict(done)
     res["t"] = "dresult"
+    burst = config.direct_burst
+    rx = msg.get("_rx_t")
+    if burst and rx is not None:
+        # decode→result turnover, stamped for the caller's lease
+        # pipelining EWMA (burst mode only — the pre-burst dresult
+        # stays byte-identical under the kill switch)
+        res["dur"] = time.time() - rx
     dconn.send_result(res)
     note = dict(done)
     note["t"] = "direct_done"
     note["spec"] = spec
-    worker.queue_done(note)
+    if burst and rx is not None:
+        # same stamp on the bookkeeping side: the raylet's FINISHED
+        # event keeps exec latency when the RUNNING note is elided
+        note["dur"] = res["dur"]
+    if burst and msg.get("_inline"):
+        # inline exec on the conn thread: the note coalesces into the
+        # train's batched direct_notes flush (see _DirectConn.flush_notes)
+        dconn.note_buf.append(note)
+    else:
+        worker.queue_done(note)
 
 
 def _resolve_callable(worker: RemoteWorker, spec: TaskSpec, fn_blob):
@@ -579,9 +632,7 @@ def _run_streaming(worker: RemoteWorker, spec: TaskSpec, gen):
 
 
 def _apply_runtime_env(spec: TaskSpec):
-    from ray_tpu.core import runtime_env as _rtenv
-    from ray_tpu.core.worker import global_worker
-
+    _, _, _, _, _, _rtenv, global_worker = _hot()
     _rtenv.ensure_runtime_env(global_worker(), spec.runtime_env)
 
 
@@ -663,8 +714,7 @@ class _run_span:
     visible while the other 99% pay ~nothing."""
 
     def __init__(self, spec: TaskSpec):
-        from ray_tpu.util import tracing
-
+        tracing = _hot()[4]
         self._sp = None
         self._err_ctx = None
         ctx = spec.trace_ctx
@@ -791,11 +841,20 @@ async def _execute_async_inner(worker: RemoteWorker, msg: dict) -> bool:
 
 
 def execute_task(worker: RemoteWorker, msg: dict):
-    if msg.get("direct_conn") is not None:
+    dconn = msg.get("direct_conn")
+    if dconn is not None:
         # the raylet never saw this call dispatch: a batched RUNNING note
         # keeps the timeline / state API seeing in-flight direct work
         # (rides the ~2ms done-flusher, not the latency path)
-        worker.queue_done({"t": "direct_running", "spec": msg["spec"]})
+        note = {"t": "direct_running", "spec": msg["spec"]}
+        if dconn.coalesce and config.direct_burst:
+            # mid-train inline exec: batch the note with its direct_done
+            # into the train's one direct_notes frame.  Head-of-train and
+            # queue-path calls keep the per-call note so a LONG direct
+            # call is still visible (and raylet-cancellable) mid-exec.
+            dconn.note_buf.append(note)
+        else:
+            worker.queue_done(note)
     with _run_span(msg["spec"]) as rs:
         ok = _execute_task_inner(worker, msg)
         rs.done(ok)
@@ -808,12 +867,8 @@ def execute_task(worker: RemoteWorker, msg: dict):
 
 def _execute_task_inner(worker: RemoteWorker, msg: dict):
     spec: TaskSpec = msg["spec"]
-    from ray_tpu.runtime_context import (
-        _current_deadline,
-        _current_task_id,
-    )
-    from ray_tpu.util import profiling
-
+    _current_deadline, _current_task_id, _chaos, profiling, tracing, _, _ \
+        = _hot()
     _ctx_token = _current_task_id.set(spec.task_id)
     _dl_token = _current_deadline.set(
         spec.deadline if config.deadlines else None)
@@ -832,8 +887,6 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
                 f"undeclared concurrency group "
                 f"{msg['__bad_group__']!r} for {spec.name}")
         _apply_runtime_env(spec)
-        from ray_tpu.util import tracing
-
         _preflight(worker, spec)
         with tracing.maybe_span("worker.get_args"):
             args, kwargs = _resolve_args(worker, spec,
@@ -842,8 +895,6 @@ def _execute_task_inner(worker: RemoteWorker, msg: dict):
         # chaos slow-executor seam, then gate again — an injected delay
         # must be visible to the deadline check like real slowness
         _preflight(worker, spec)
-        from ray_tpu.util import chaos as _chaos
-
         _chaos.exec_delay(spec.name)
         _preflight(worker, spec)
         worker.cancel_registry.register(

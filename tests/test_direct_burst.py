@@ -1,0 +1,289 @@
+"""Coalesced direct burst mode (core/direct.py windowed ack).
+
+Correctness envelope for the burst fast path:
+
+* windowed-ack ordering — a deep async burst that STARTS on the relayed
+  path and switches to the direct channel mid-stream (watermark
+  observation) must preserve per-handle FIFO order end to end;
+* generation fencing mid-burst — SIGKILL the callee with a partially
+  submitted burst in flight: every call either returns or raises the
+  typed ActorDiedError, nothing executes twice on the restarted
+  instance (unique-tag proof), and new calls serve from the restart;
+* callee death with a partially-acked window — no restarts: every
+  unacked slot resolves to a typed error (zero lost, zero hung);
+* recursive cancel reaching UNFLUSHED burst entries — a dcancel queued
+  in front of a dcall still sitting in the coalescing send buffer
+  cancels it before the callee's pre-exec check can run;
+* kill-switch parity — RAY_TPU_DIRECT_BURST=0 restores the pre-burst
+  drain-and-relay behavior (deep bursts hand back to the raylet) while
+  keeping results and ordering correct.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.worker import global_worker
+
+
+def _wait_until(predicate, timeout=30.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except Exception:  # noqa: BLE001 — transient during recovery
+            pass
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _engage(svc, warmups=3):
+    """Relayed warm-up + wait for the direct channel to dial."""
+    for _ in range(warmups):
+        ray_tpu.get(svc.ping.remote())
+    d = global_worker()._direct
+    _wait_until(lambda: svc.actor_id in d._channels
+                and d._channels[svc.actor_id].alive,
+                timeout=15, msg="direct engagement")
+    return d
+
+
+@ray_tpu.remote
+class Seq:
+    """Records the arrival order of every call it executes."""
+
+    def __init__(self):
+        self.log = []
+
+    def ping(self):
+        return b"ok"
+
+    def mark(self, i):
+        self.log.append(i)
+        return i
+
+    def history(self):
+        return list(self.log)
+
+
+@ray_tpu.remote(max_restarts=1)
+class Tagged:
+    def __init__(self, path):
+        self.path = path
+
+    def ping(self):
+        return b"ok"
+
+    def pid(self):
+        return os.getpid()
+
+    def tag(self, t, delay=0.0):
+        if delay:
+            time.sleep(delay)
+        with open(self.path, "a") as f:
+            f.write(t + "\n")
+        return t
+
+
+def _tags(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [l.strip() for l in f if l.strip()]
+
+
+# ----------------------------------------------------- ordering / window
+
+
+def test_windowed_ack_ordering_across_watermark_switch(ray_start_regular):
+    """Calls 0..N fired before AND after the relayed→direct watermark
+    switch must execute in submission order: the switch happens mid-burst
+    (first gets observe the relayed watermark while later submits are
+    still queuing), and past W in flight the windowed ack starts
+    interleaving demux with submit — neither seam may reorder."""
+    svc = Seq.remote()
+    n = 300  # several full burst windows deep
+    refs = [svc.mark.remote(i) for i in range(n)]
+    # observing early results mid-burst clears the watermark and flips
+    # later submits onto the direct channel while the burst is live
+    assert ray_tpu.get(refs[0], timeout=60) == 0
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == list(range(n))
+    # the watermark is now observed: the next burst ENGAGES the direct
+    # channel on its first submit and pipelines the rest — the
+    # relayed→direct switch happens inside this burst
+    refs2 = [svc.mark.remote(n + i) for i in range(n)]
+    assert ray_tpu.get(refs2, timeout=120) == [n + i for i in range(n)]
+    d = global_worker()._direct
+    assert svc.actor_id in d._channels, "burst never engaged direct"
+    assert ray_tpu.get(svc.history.remote(),
+                       timeout=60) == list(range(2 * n))
+
+
+# ------------------------------------------------------- fencing / death
+
+
+def test_generation_fencing_mid_burst(ray_start_regular, tmp_path):
+    """SIGKILL the callee with a burst partially in flight: unacked
+    calls fail TYPED (never silently lost), no call executes twice
+    across the restart (unique tags), and the restarted generation
+    serves new calls."""
+    marker = str(tmp_path / "tags")
+    svc = Tagged.remote(marker)
+    _engage(svc)
+    pid = ray_tpu.get(svc.pid.remote(), timeout=30)
+
+    refs = [svc.tag.remote(f"burst-{i}", 0.002) for i in range(120)]
+    # kill once the burst is demonstrably mid-flight: some executed,
+    # the window still has unacked slots
+    _wait_until(lambda: len(_tags(marker)) >= 10, timeout=30,
+                msg="burst partially executed before the kill")
+    os.kill(pid, signal.SIGKILL)
+
+    outcomes = {}
+    for i, r in enumerate(refs):
+        try:
+            outcomes[f"burst-{i}"] = ("ok", ray_tpu.get(r, timeout=60))
+        except ray_tpu.ActorDiedError:
+            outcomes[f"burst-{i}"] = ("died", None)
+    # zero lost: every slot resolved one way or the other (a hang would
+    # have tripped the get timeout above)
+    assert len(outcomes) == 120
+
+    # the restarted instance must serve NEW calls under the bumped
+    # generation (stale frames were fenced, not replayed)
+    _wait_until(lambda: ray_tpu.get(svc.tag.remote("post-restart"),
+                                    timeout=10) == "post-restart",
+                timeout=60, msg="restarted actor serving calls")
+
+    final = _tags(marker)
+    dupes = {t for t in final if final.count(t) > 1}
+    assert not dupes, f"call(s) executed twice across the restart: {dupes}"
+    for t, (kind, val) in outcomes.items():
+        if kind == "ok":
+            assert final.count(t) == 1, (
+                f"{t} reported ok but executed {final.count(t)} times")
+
+
+def test_callee_death_partially_acked_window(ray_start_regular, tmp_path):
+    """No restarts: killing the callee with a partially-acked window
+    must resolve EVERY outstanding slot to the typed ActorDiedError —
+    acked results stay valid, unacked ones error, none hang."""
+    marker = str(tmp_path / "tags")
+
+    @ray_tpu.remote(max_restarts=0)
+    class OneShot:
+        def ping(self):
+            return b"ok"
+
+        def pid(self):
+            return os.getpid()
+
+        def tag(self, t, delay=0.0):
+            if delay:
+                time.sleep(delay)
+            with open(marker, "a") as f:
+                f.write(t + "\n")
+            return t
+
+    svc = OneShot.remote()
+    _engage(svc)
+    pid = ray_tpu.get(svc.pid.remote(), timeout=30)
+    refs = [svc.tag.remote(f"w-{i}", 0.002) for i in range(150)]
+    _wait_until(lambda: len(_tags(marker)) >= 20, timeout=30,
+                msg="window partially acked before the kill")
+    os.kill(pid, signal.SIGKILL)
+
+    ok = died = 0
+    for i, r in enumerate(refs):
+        try:
+            assert ray_tpu.get(r, timeout=60) == f"w-{i}"
+            ok += 1
+        except ray_tpu.ActorDiedError:
+            died += 1
+    assert ok + died == 150  # nothing lost, nothing hung
+    assert died > 0, "the kill landed after the whole burst completed"
+    final = _tags(marker)
+    assert not {t for t in final if final.count(t) > 1}, (
+        "a call executed twice after the callee died")
+
+
+# ---------------------------------------------------------------- cancel
+
+
+def test_recursive_cancel_reaches_unflushed_burst_entries(
+        ray_start_regular, tmp_path):
+    """A cancel racing a dcall that is still COALESCING in the send
+    buffer must queue its dcancel in front of the dcall: the callee's
+    registry marks the task before the pre-exec check runs, so the call
+    raises TaskCancelledError and never executes."""
+    marker = str(tmp_path / "tags")
+    svc = Tagged.remote(marker)
+    d = _engage(svc)
+
+    # hold the coalescing buffer still: no background micro-flush, so a
+    # second submit (depth>1, below the half-window threshold) stays in
+    # ch.sendbuf until something flushes explicitly
+    d._arm_flusher = lambda: None
+
+    blocker = svc.tag.remote("blocker", 0.8)  # depth 1: flushes, executes
+    time.sleep(0.1)  # let the blocker's frame hit the wire
+    victim = svc.tag.remote("victim")  # depth 2: buffered, unflushed
+    ch = d._channels[svc.actor_id]
+    with ch.lock:
+        buffered = [f for f in ch.sendbuf if f.get("t") == "dcall"]
+    assert buffered, "victim dcall was not coalescing in the send buffer"
+
+    assert ray_tpu.cancel(victim, recursive=True)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    assert ray_tpu.get(blocker, timeout=30) == "blocker"
+
+    # settle, then prove the victim never executed
+    ray_tpu.get(svc.tag.remote("after"), timeout=30)
+    final = _tags(marker)
+    assert "victim" not in final
+    assert final.count("blocker") == 1 and final.count("after") == 1
+
+
+# ----------------------------------------------------------- kill switch
+
+
+def test_kill_switch_parity(monkeypatch):
+    """RAY_TPU_DIRECT_BURST=0 restores the pre-burst contract: the
+    direct channel stays a latency transport (deep bursts drain the
+    window and hand back to the relayed path) and results/ordering stay
+    correct.  The env var is set before init so callee processes
+    inherit it too (their note/result coalescing is also gated)."""
+    monkeypatch.setenv("RAY_TPU_DIRECT_BURST", "0")
+    ray_tpu.config.reload()  # flags materialized at import: re-read env
+    ray_tpu.init(num_cpus=4)
+    try:
+        assert ray_tpu.config.direct_burst is False
+        svc = Seq.remote()
+        _engage(svc)
+        n = 300  # far past direct_pipeline_depth
+        refs = [svc.mark.remote(i) for i in range(n)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(n))
+        assert ray_tpu.get(svc.history.remote(),
+                           timeout=60) == list(range(n))
+        # pre-burst behavior: the deep burst handed calls back to the
+        # relayed path (watermark recorded) instead of pipelining —
+        # with burst ON this stays zero once engaged (covered above)
+        d = global_worker()._direct
+        st = d._actors.get(svc.actor_id)
+        assert st is not None and st["completed"] >= 1
+        # sync call-response still rides the direct channel (latency
+        # path intact under the kill switch)
+        for i in range(5):
+            assert ray_tpu.get(svc.mark.remote(n + i),
+                               timeout=30) == n + i
+    finally:
+        ray_tpu.shutdown()
+        # un-poison the materialized flag for later tests in-process
+        os.environ.pop("RAY_TPU_DIRECT_BURST", None)
+        ray_tpu.config.reload()

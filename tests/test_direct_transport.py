@@ -303,17 +303,30 @@ def test_partition_mid_direct_call_no_double_execution(tmp_path):
 
         @ray_tpu.remote(max_restarts=2, resources={"slot": 0.5})
         class Svc:
-            def bump(self, path):
+            def bump(self, path, tag):
                 with open(path, "a") as f:
-                    f.write("x")
+                    f.write(tag + "\n")
                 return True
 
         svc = Svc.remote()
         d = global_worker()._direct
-        successes = 0
-        for _ in range(3):
-            assert ray_tpu.get(svc.bump.remote(str(marker)), timeout=60)
-            successes += 1
+
+        # every bump writes a UNIQUE tag: the double-execution check is
+        # then per-call ("no tag twice"), immune to compensating-error
+        # coincidences that a bare character count can hide, and immune
+        # to the stuck frame racing in just before SIGSTOP lands (its
+        # tag may appear once; it must never appear twice)
+        def tags():
+            if not marker.exists():
+                return []
+            return [l for l in marker.read_text().splitlines() if l]
+
+        served_tags = []
+        for i in range(3):
+            tag = f"warm-{i}"
+            assert ray_tpu.get(svc.bump.remote(str(marker), tag),
+                               timeout=60)
+            served_tags.append(tag)
         _wait_until(lambda: svc.actor_id in d._channels, timeout=15,
                     msg="direct engagement before the partition")
 
@@ -327,6 +340,7 @@ def test_partition_mid_direct_call_no_double_execution(tmp_path):
         # keep executing, which is a stall, not a partition)
         worker_pids = _child_pids(victim.proc.pid)
         assert worker_pids, "victim node spawned no workers"
+        frozen_at = time.monotonic()
         c.pause_node(victim)
         for pid in worker_pids:
             try:
@@ -336,7 +350,7 @@ def test_partition_mid_direct_call_no_double_execution(tmp_path):
 
         # in-flight direct call INTO the freeze: the frame lands in the
         # frozen worker's socket buffer and must never execute
-        stuck = svc.bump.remote(str(marker))
+        stuck = svc.bump.remote(str(marker), "stuck")
         with pytest.raises((ray_tpu.ActorDiedError,
                             ray_tpu.GetTimeoutError)):
             ray_tpu.get(stuck, timeout=30)
@@ -345,13 +359,24 @@ def test_partition_mid_direct_call_no_double_execution(tmp_path):
         deadline = time.monotonic() + 60
         served = 0
         while served < 2 and time.monotonic() < deadline:
+            tag = f"retry-{served}"
             try:
-                if ray_tpu.get(svc.bump.remote(str(marker)), timeout=10):
+                if ray_tpu.get(svc.bump.remote(str(marker), tag),
+                               timeout=10):
                     served += 1
-                    successes += 1
+                    served_tags.append(tag)
             except (ray_tpu.ActorDiedError, ray_tpu.GetTimeoutError):
                 time.sleep(0.3)
         assert served == 2, "actor never failed over"
+
+        # the freeze gate only trips when the observed scheduling gap
+        # exceeds RAY_TPU_DIRECT_FREEZE_GATE_S: hold the stop window
+        # provably past the gate (it almost always already is — the
+        # failed get above blocks for seconds — so this rarely sleeps)
+        gate_margin = 0.8 + 0.6
+        remaining = frozen_at + gate_margin - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
 
         # heal the partition; the resurrected worker's freeze gate must
         # reject the stale buffered frame instead of executing it
@@ -361,10 +386,32 @@ def test_partition_mid_direct_call_no_double_execution(tmp_path):
             except OSError:
                 pass
         c.resume_node(victim)
-        time.sleep(3.0)  # give a wrongly-revived frame time to show up
 
-        assert marker.read_text().count("x") == successes, (
-            "a direct call executed twice across the partition")
+        # poll until the marker is STABLE (no growth across a full
+        # settle window) instead of one fixed sleep: a wrongly-revived
+        # frame shows up as growth and fails fast below, while a clean
+        # run stops polling as soon as the window passes
+        stable_since = time.monotonic()
+        last = tags()
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+            cur = tags()
+            if cur != last:
+                last = cur
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since >= 1.5:
+                break
+
+        final = tags()
+        for tag in served_tags:
+            assert final.count(tag) == 1, (
+                f"served call {tag!r} executed {final.count(tag)} times")
+        assert final.count("stuck") <= 1, (
+            "the stale buffered frame executed after the heal")
+        dupes = {t for t in final if final.count(t) > 1}
+        assert not dupes, (
+            f"direct call(s) executed twice across the partition: {dupes}")
     finally:
         c.shutdown()
 

@@ -167,8 +167,10 @@ def test_gcs_profile_table_bounds_and_fencing(monkeypatch):
     from ray_tpu.core.gcs import GcsCore
 
     core = GcsCore()
-    old = config._flags["profile_table_max"].value
-    config._flags["profile_table_max"].value = 5
+    # assign through the config object, not the _Flag: non-live flags are
+    # materialized as instance attributes and only __setattr__ re-syncs
+    old = config.profile_table_max
+    config.profile_table_max = 5
     try:
         recs = [{"stack": f"s{i}", "count": 1, "t0": float(i),
                  "t1": float(i) + 1} for i in range(8)]
@@ -188,7 +190,7 @@ def test_gcs_profile_table_bounds_and_fencing(monkeypatch):
         core.add_profile_samples("ghost", recs, incarnation=3)
         assert "ghost" not in core.profile_table_stats()["nodes"]
     finally:
-        config._flags["profile_table_max"].value = old
+        config.profile_table_max = old
         core.stop()
 
 

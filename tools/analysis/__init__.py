@@ -1,6 +1,6 @@
 """Concurrency & config static-analysis suite for the ray_tpu runtime.
 
-Four AST passes over ``ray_tpu/`` (the Python stand-in for the
+Five AST passes over ``ray_tpu/`` (the Python stand-in for the
 compiler-enforced thread-safety annotations the C++ reference gets from
 absl/clang):
 
@@ -10,7 +10,10 @@ absl/clang):
   made while a lock is held;
 * **env-registry** — every ``RAY_TPU_*`` env var declared through the
   ``core/config.py`` registry, no direct reads, README table in sync;
-* **thread-hygiene** — every thread named, and daemonized or joined.
+* **thread-hygiene** — every thread named, and daemonized or joined;
+* **direct-hot-path** — the direct transport's conn-thread lock budget
+  is frozen: new locks on the per-call burst path need an audited
+  allowlist entry or a ``# hotpath-ok:`` justification.
 
 Run ``python -m tools.analysis`` (exit 0 = clean; any violation or
 reason-less suppression = exit 1).  The runtime half of the tooling is
@@ -23,8 +26,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Tuple
 
-from tools.analysis import (blocking_under_lock, env_registry,
-                            lock_discipline, thread_hygiene)
+from tools.analysis import (blocking_under_lock, direct_hot_path,
+                            env_registry, lock_discipline, thread_hygiene)
 from tools.analysis.common import (SourceFile, Suppression, Violation,
                                    iter_py_files, load_files)
 
@@ -46,6 +49,7 @@ def analyze(repo_root: str) -> Tuple[List[Violation], List[Suppression],
         violations += lock_discipline.check(sf)
         violations += blocking_under_lock.check(sf)
         violations += thread_hygiene.check(sf)
+        violations += direct_hot_path.check(sf)
         suppressions += sf.all_suppressions()
 
     defs = env_registry.collect_defines(pkg_files)

@@ -57,7 +57,7 @@ def main(argv=None) -> int:
         summary = ", ".join(f"{n} {p}" for p, n in sorted(counts.items()))
         print(f"\nFAIL: {len(violations)} violation(s) ({summary})")
         return 1
-    print(f"OK: 0 violations across 4 passes "
+    print(f"OK: 0 violations across 5 passes "
           f"({len(defs)} env flags declared, "
           f"{len(suppressions)} explained suppressions).")
     return 0

@@ -21,7 +21,8 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional
 
 #: escape-hatch / annotation comment markers understood by the passes
-SUPPRESSION_KINDS = ("unguarded-ok", "blocking-ok", "env-ok", "joined-by")
+SUPPRESSION_KINDS = ("unguarded-ok", "blocking-ok", "env-ok", "joined-by",
+                     "hotpath-ok")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*(" + "|".join(SUPPRESSION_KINDS) + r")\s*:?\s*(.*)")

@@ -1,0 +1,113 @@
+"""Direct-transport hot-path lock budget.
+
+The callee conn thread (``DirectServer._conn_loop`` → ``_handle_call``
+→ inline ``execute_task`` → ``_deliver_result``) executes every burst
+call; each lock acquisition on that path is paid per call — and under
+``RAY_TPU_DEBUG_LOCKS=1`` each acquisition also pays the watchdog, so a
+stray lock quietly erodes the burst throughput the transport exists to
+provide.  This pass freezes the path's lock set: any ``with <lock>:``
+(or explicit ``.acquire()``) inside a hot-path function whose lock name
+is not in the audited allowlist is a violation.
+
+Growing the allowlist is allowed — with a review: either add the name to
+``ALLOWED`` here (with a comment saying what it protects and why it must
+be per-call), or annotate the site with ``# hotpath-ok: <reason>`` when
+the acquisition is on a cold branch (teardown, error path) the lexical
+scan cannot distinguish.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.analysis.common import SourceFile, Violation
+
+PASS = "direct-hot-path"
+
+#: hot-path roots per file: functions the conn thread runs per call (or
+#: per train).  Lexical scope only — helpers they call live in the same
+#: two files and are listed explicitly.
+HOT_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
+    "ray_tpu/core/direct.py": (
+        # callee side: accept-loop frame handling + retry dedup
+        "_conn_loop", "_handle_call", "remember", "admit",
+        # per-result / per-train emission
+        "send_result", "flush_results", "flush_notes",
+    ),
+    "ray_tpu/core/worker_main.py": (
+        # inline execution on the conn thread + completion routing
+        "execute_task", "_execute_task_inner", "_deliver_result",
+        "queue_direct_notes",
+    ),
+}
+
+#: audited per-call locks (what each protects — keep this list honest):
+ALLOWED: Set[str] = {
+    "exec_lock",     # serializes task execution with raylet dispatches
+    "send_lock",     # frame interleaving on the conn socket
+    "_dedup_lock",   # retry-dedup table (remember/admit)
+    "_done_lock",    # done/notes buffer handoff to the flusher thread
+    "_ref_lock",     # process-local ref counts (batched pins)
+    "_conns_lock",   # conn registry (accept/teardown, amortized)
+    "_lock",         # cancel-registry probe (empty-dict fast path guard)
+    "recv_lock",     # caller-side demux ownership (shared helpers)
+}
+
+
+def _lock_token(expr: ast.expr) -> str:
+    """The lock's name for ``with self.x.y_lock:`` / ``with g_lock:`` /
+    ``lock.acquire()`` shapes; '' when the expression is not lock-like."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return ""
+    return name if "lock" in name.lower() else ""
+
+
+class _HotChecker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: List[Violation]):
+        self.sf = sf
+        self.out = out
+
+    def _flag(self, node: ast.AST, name: str):
+        if self.sf.suppression(node.lineno, "hotpath-ok",
+                               getattr(node, "end_lineno", None)):
+            return
+        self.out.append(Violation(
+            self.sf.rel, node.lineno, PASS,
+            f"new lock '{name}' on the direct conn-thread hot path — "
+            f"this is paid per burst call; move it off the hot path, or "
+            f"allowlist it in tools/analysis/direct_hot_path.py with a "
+            f"justification (cold branch: '# hotpath-ok: <reason>')"))
+
+    def visit_With(self, node: ast.With):
+        for item in node.items:
+            name = _lock_token(item.context_expr)
+            if name and name not in ALLOWED:
+                self._flag(item.context_expr, name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+            name = _lock_token(fn.value)
+            if name and name not in ALLOWED:
+                self._flag(node, name)
+        self.generic_visit(node)
+
+
+def check(sf: SourceFile) -> List[Violation]:
+    roots = HOT_FUNCTIONS.get(sf.rel.replace("\\", "/"))
+    if not roots:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in roots:
+            checker = _HotChecker(sf, out)
+            for stmt in node.body:
+                checker.visit(stmt)
+    return out
