@@ -20,11 +20,12 @@ from typing import Dict, List, Optional
 
 class NodeHandle:
     def __init__(self, proc: subprocess.Popen, node_id: str, port: int,
-                 resources: Dict[str, float]):
+                 resources: Dict[str, float], object_store_mb: int = 128):
         self.proc = proc
         self.node_id = node_id
         self.port = port
         self.resources = resources
+        self.object_store_mb = object_store_mb
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -101,7 +102,7 @@ def spawn_raylet(gcs_address: str, resources: Dict[str, float],
     banner = _read_tagged_line(proc, "RAYLET")
     fields = dict(kv.split("=") for kv in banner.split()[1:])
     return NodeHandle(proc, fields["node_id"], int(fields["port"]),
-                      dict(resources))
+                      dict(resources), object_store_mb=object_store_mb)
 
 
 class Cluster:
@@ -111,12 +112,30 @@ class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_resources: Optional[Dict[str, float]] = None,
                  env: Optional[Dict[str, str]] = None,
-                 gcs_persist_path: Optional[str] = None):
+                 gcs_persist_path: Optional[str] = None,
+                 chaos_control_file: Optional[str] = None,
+                 memory_usage_file: Optional[str] = None):
         """``gcs_persist_path``: enable GCS fault tolerance — durable
         tables snapshot there and ``restart_gcs()`` brings the control
         plane back on the SAME port (raylets need
-        RAY_TPU_GCS_RECONNECT_TIMEOUT_S > 0 to ride through)."""
+        RAY_TPU_GCS_RECONNECT_TIMEOUT_S > 0 to ride through).
+
+        ``chaos_control_file``: export this path as the chaos control file
+        (``RAY_TPU_CHAOS_NET_PARTITION_FILE``) into every spawned
+        GCS/raylet/worker, so a chaos driver steers partitions and
+        slow-exec windows in live processes by rewriting one JSON file.
+
+        ``memory_usage_file``: export as ``RAY_TPU_MEMORY_USAGE_FILE`` and
+        enable the raylet memory monitor — the driver injects OOM
+        pressure by writing a usage fraction into the file."""
         self._env = make_cluster_env(env)
+        if chaos_control_file:
+            self._env["RAY_TPU_CHAOS_NET_PARTITION_FILE"] = \
+                chaos_control_file
+        if memory_usage_file:
+            self._env["RAY_TPU_MEMORY_USAGE_FILE"] = memory_usage_file
+            self._env.setdefault("RAY_TPU_MEMORY_MONITOR_INTERVAL_S",
+                                 "0.25")
         self._gcs_persist = gcs_persist_path
         self.nodes: List[NodeHandle] = []
         self._gcs_proc, self.address = spawn_gcs(
@@ -163,6 +182,26 @@ class Cluster:
                 time.sleep(0.3)
         raise RuntimeError(f"could not restart GCS: {last_err}")
 
+    def replace_node(self, node: NodeHandle) -> NodeHandle:
+        """SIGKILL ``node`` and respawn a replacement with the same
+        resources and store size IN ITS SLOT (same index in ``nodes``), so
+        chaos schedules addressing nodes by slot keep a stable mapping
+        across kills.  Returns the replacement handle."""
+        try:
+            idx = self.nodes.index(node)
+        except ValueError:
+            idx = None
+        self.remove_node(node)
+        handle = spawn_raylet(self.address, dict(node.resources),
+                              node.object_store_mb, self._env)
+        if idx is None or idx >= len(self.nodes):
+            self.nodes.append(handle)
+        else:
+            self.nodes.insert(idx, handle)
+        if getattr(self, "head_node", None) is node:
+            self.head_node = handle
+        return handle
+
     def pause_node(self, node: NodeHandle):
         """SIGSTOP the raylet process — simulates a network partition /
         long stall: the node stops heartbeating and answering liveness
@@ -186,6 +225,20 @@ class Cluster:
             node.proc.wait(timeout=10)
         if node in self.nodes:
             self.nodes.remove(node)
+        # A SIGKILLed raylet never unlinks its shm store segment; reap it
+        # here so chaos runs don't bleed host memory (the runtime also
+        # sweeps dead-pid segments on the next raylet start).
+        import glob
+        import shutil
+
+        for path in glob.glob(f"/dev/shm/rt_store_{node.proc.pid}_*"):
+            if path.endswith(".spill"):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def connect(self):
         import ray_tpu

@@ -14,13 +14,23 @@ from __future__ import annotations
 import socket
 import sys
 import threading
+import time
 from typing import Dict, Optional
 
 from ray_tpu.core import protocol
+from ray_tpu.core.config import config
 from ray_tpu.core.gcs import GcsClient
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.worker import Worker
 from ray_tpu.util.locks import make_lock
+from ray_tpu.util.retry import BackoffPolicy
+
+config.define("gcs_client_reconnect_attempts", int, 4,
+              "Driver-side GCS reconnect: how many re-dial attempts a "
+              "GCS op gets after its connection drops (a GCS restart "
+              "leaves the old socket dead while the service comes back), "
+              "spaced by the jittered RAY_TPU_RETRY_BACKOFF_* policy so "
+              "many drivers don't re-dial a restarting GCS in lockstep.")
 
 
 class ClientWorker(Worker):
@@ -181,12 +191,26 @@ class ClientWorker(Worker):
         return msg["value"]
 
     def _gcs_call(self, op, *args):
-        """GCS ops with one reconnect retry — after a GCS restart (fault
-        tolerance) the old socket is dead but the service is back."""
+        """GCS ops with reconnect retries — after a GCS restart (fault
+        tolerance) the old socket is dead but the service comes back
+        within a few seconds.  Re-dials ride the unified jittered backoff
+        policy: a fleet of drivers (or one driver fanning many threads
+        into this path) spreads its re-dials instead of hammering the
+        port the instant it reopens."""
         try:
             return getattr(self.gcs, op)(*args)
         except (ConnectionError, TimeoutError, OSError):
-            new = GcsClient(self._gcs_address)
+            pass
+        policy = BackoffPolicy()
+        attempts = max(1, config.gcs_client_reconnect_attempts)
+        for attempt in range(attempts):
+            try:
+                new = GcsClient(self._gcs_address)
+            except (ConnectionError, TimeoutError, OSError):
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(policy.delay(attempt))
+                continue
             old, self.gcs = self.gcs, new
             try:
                 old.close()

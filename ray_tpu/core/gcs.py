@@ -215,8 +215,12 @@ class GcsCore:
             for info in self._actors.values():
                 if info.get("state") == "alive":
                     info["state"] = "restarting"
+            # Incarnations count too: a cluster of pure task nodes has no
+            # durable actors/kv, but its raylets still need ghost-death
+            # declarations if they vanish during the outage.
             self._restored = bool(self._actors or self._kv
-                                  or self._cluster_pgs)
+                                  or self._cluster_pgs
+                                  or self._incarnations)
 
     def _write_snapshot(self):
         import pickle
@@ -353,6 +357,10 @@ class GcsCore:
                 "suspect": False,
                 "incarnation": inc,
                 "last_heartbeat": time.monotonic(),
+                # wall-clock registration stamp: lets chaos/soak tooling
+                # verify that a mass reconnect after a GCS restart
+                # re-registered STAGGERED (thundering-herd regression)
+                "registered_at": time.time(),
             }
             snapshot = [dict(n) for n in self._nodes.values()]
         # Persist the incarnation bump SYNCHRONOUSLY (registrations are
@@ -610,7 +618,12 @@ class GcsCore:
         died DURING the GCS outage never re-registers and (the node table
         being soft state) never produces a node-death event either, so
         those actors would stay 'restarting' forever and named-actor
-        callers would hang.  Once the reconnect window elapses: actors
+        callers would hang.  Once the reconnect window elapses: raylets
+        that held a live incarnation in the snapshot but never returned
+        are DECLARED DEAD (fence + node_dead publish — peers must fail
+        forwarded work and reconstruct, exactly as for a probe-confirmed
+        death; without this, an in-flight actor call to a node killed
+        during the outage never resolves), actors
         whose owner node never returned go to 'dead' (lookups then raise
         instead of hanging), and cluster-PG bundles assigned to ghost
         nodes are re-placed through the normal dead-node repair path.  A
@@ -626,6 +639,17 @@ class GcsCore:
                 return
             with self._lock:
                 live = {nid for nid, i in self._nodes.items() if i["alive"]}
+                # Raylets that held a live incarnation at snapshot time
+                # (above their fence watermark) and never re-registered
+                # died DURING the outage — the suspicion machine never saw
+                # them, so without an explicit declaration here no
+                # node_dead is ever published and peers keep forwarding to
+                # (and waiting on) a corpse: in-flight actor calls hang
+                # instead of failing over.
+                ghost_raylets = [
+                    (nid, inc) for nid, inc in self._incarnations.items()
+                    if nid not in live
+                    and inc > self._fenced_incs.get(nid, -1)]
                 ghost_actors = [
                     aid for aid, i in self._actors.items()
                     if i.get("state") in ("restarting", "pending")
@@ -638,6 +662,26 @@ class GcsCore:
                         if n not in live)
                     ghost_nodes.update(
                         n for n in entry["pending"] if n not in live)
+            # Declare ghost raylets dead FIRST: the node_dead push is what
+            # makes peers fail forwarded work (ActorDiedError), rotate
+            # pulls, and reconstruct sole-copy objects.  A slow-but-alive
+            # raylet declared here recovers like any probe-death false
+            # positive: its next heartbeat returns "fenced", it kills its
+            # stale workers, and re-registers under a fresh incarnation.
+            for nid, inc in ghost_raylets:
+                with self._lock:
+                    info = self._nodes.get(nid)
+                    if info is not None and info["alive"]:
+                        continue  # reconnected since the sweep above
+                    if inc <= self._fenced_incs.get(nid, -1):
+                        continue
+                    self._fenced_incs[nid] = inc
+                    self._m_deaths += 1
+                    self._mark_dirty()  # the fence must survive a restart
+                self._publish("node_dead", {
+                    "node_id": nid,
+                    "reason": "raylet never reconnected after GCS restart",
+                    "incarnation": inc})
             for aid in ghost_actors:
                 with self._lock:
                     info = self._actors.get(aid)

@@ -138,6 +138,53 @@ def _prefault(path: str):
         pass  # old kernel / permissions: stay lazy
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def sweep_dead_store_files(shm_dir: str = "/dev/shm") -> list:
+    """Reclaim store segments abandoned by crashed raylets.
+
+    A segment's name embeds its creating raylet's pid
+    (``rt_store_<pid>_<hex>``, `raylet_main.py`); the raylet unlinks it
+    on clean shutdown, but SIGKILL / OOM-kill / a segfault skips that —
+    and a shm file nobody will ever unlink eats host memory forever.
+    Every raylet sweeps at startup: any segment whose creator pid is
+    gone is garbage by construction (live raylets' pids still exist, so
+    their segments are never touched).  Returns the removed paths."""
+    import shutil
+
+    removed = []
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return removed
+    for name in names:
+        if not name.startswith("rt_store_") or name.endswith(".spill"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[2])
+        except (IndexError, ValueError):
+            continue
+        if _pid_alive(pid):
+            continue
+        path = os.path.join(shm_dir, name)
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        shutil.rmtree(path + ".spill", ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
 def create_store_file(path: str, capacity_bytes: int, table_cap: int = 1 << 16):
     rc = _get_lib().rt_store_init(path.encode(), capacity_bytes, table_cap)
     if rc != 0:

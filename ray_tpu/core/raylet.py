@@ -76,6 +76,13 @@ config.define("gcs_reconnect_timeout_s", float, 0.0,
               "(reference: raylet<->GCS reconnect in "
               "`test_gcs_fault_tolerance.py`).  0 = shut down immediately "
               "(the default; process trees reap cleanly in tests).")
+config.define("gcs_reconnect_stagger_s", float, 0.75,
+              "GCS mass-reconnect de-synchronizer: every raylet sees the "
+              "GCS die at the same instant, so before the FIRST reconnect "
+              "dial each sleeps uniform[0, this] — the thundering herd of "
+              "dials + re-registrations spreads across the window instead "
+              "of landing on the restarted GCS in lockstep.  Later "
+              "attempts use the jittered exponential backoff policy.")
 config.define("memory_monitor_interval_s", float, 0.0,
               "OOM prevention (reference: `memory_monitor.h:52`): poll "
               "host memory every interval and kill a worker above the "
@@ -2162,6 +2169,14 @@ class Raylet:
         sys.stderr.write(
             f"[ray_tpu] node {self.node_id[:8]}: GCS connection lost — "
             f"reconnecting for up to {config.gcs_reconnect_timeout_s:.0f}s\n")
+        # De-synchronize the herd: every raylet's reader thread saw the
+        # GCS socket die at the same instant; without this full-span
+        # stagger they all dial — and then re-register, re-subscribe, and
+        # re-publish their whole object directories — in lockstep the
+        # moment the port reopens.
+        time.sleep(min(self._retry_policy.stagger(
+            config.gcs_reconnect_stagger_s),
+            max(0.0, deadline - time.monotonic())))
         attempt = 0
         while time.monotonic() < deadline and not self._shutdown:
             try:

@@ -49,6 +49,12 @@ def main():
 
     store_mb = args.store_mb or config.object_store_memory_mb
     shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
+    # Reclaim segments left by SIGKILLed/crashed raylets before adding
+    # our own — otherwise every hard node kill leaks store_mb of shm
+    # until reboot.
+    from ray_tpu.core.object_store import sweep_dead_store_files
+
+    sweep_dead_store_files(shm_dir)
     store_path = os.path.join(
         shm_dir, f"rt_store_{os.getpid()}_{uuid.uuid4().hex[:6]}")
     create_store_file(store_path, store_mb << 20)

@@ -25,6 +25,9 @@ Commands:
                                                range/rate/quantile reads,
                                                busiest-series table
   alerts --address ... [--log]                 firing alerts + transitions
+  chaos [--seed N] [--duration S] [--faults..] seeded compound-fault soak
+                                               + invariant bank + MTTR
+                                               report on a local cluster
 """
 
 from __future__ import annotations
@@ -481,6 +484,92 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded compound-fault soak: spawn a disposable local cluster, run
+    a deterministic fault timeline against live workloads, then run the
+    invariant bank (``util.chaos_schedule``).  Exit 0 only if every
+    invariant holds; the executed timeline (JSONL) replays a failing
+    seed exactly via ``--replay``."""
+    import tempfile
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import chaos
+    from ray_tpu.util import chaos_schedule as cs
+
+    faults = [f.strip() for f in args.faults.split(",") if f.strip()]
+    for f in faults:
+        if f not in cs.FAULT_KINDS:
+            print(f"error: unknown fault {f!r} "
+                  f"(choose from {', '.join(cs.FAULT_KINDS)})",
+                  file=sys.stderr)
+            return 2
+    workdir = args.workdir or tempfile.mkdtemp(prefix="ray_tpu_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    if args.replay:
+        events = cs.load_timeline(args.replay)
+        print(f"replaying {len(events)} events from {args.replay}")
+    else:
+        events = cs.build_schedule(args.seed, args.duration,
+                                   faults=faults, n_slots=args.nodes)
+        print(f"seed {args.seed}: {len(events)} events over "
+              f"{args.duration:.0f}s")
+    plan_path = os.path.join(workdir, "timeline.jsonl")
+    cs.write_timeline(events, plan_path)
+    log_path = os.path.join(workdir, "events.jsonl")
+    baseline = chaos.snapshot_host()
+    control_file = os.path.join(workdir, "chaos_ctrl.json")
+    memory_file = os.path.join(workdir, "mem_usage")
+    cluster = Cluster(
+        gcs_persist_path=os.path.join(workdir, "gcs_snapshot"),
+        chaos_control_file=control_file,
+        memory_usage_file=memory_file,
+        env={"RAY_TPU_GCS_RECONNECT_TIMEOUT_S": "30"})
+    try:
+        # Worker slots carry a "chaos" resource so the workloads and the
+        # MTTR probe land on killable nodes, never the quiet head.
+        pin = {"chaos": 0.01}
+        for _ in range(args.nodes):
+            cluster.add_node(num_cpus=2, resources={"chaos": 4})
+        cluster.connect()
+        cluster.wait_for_nodes()
+        workloads = [
+            cs.TaskFanoutWorkload(placement_resources=pin),
+            cs.ActorMarkerWorkload(os.path.join(workdir, "markers"),
+                                   placement_resources=pin),
+            cs.PutGetWorkload(placement_resources=pin),
+        ]
+        if args.serve:
+            workloads.append(cs.ServeWorkload())
+        runner = cs.ChaosRunner(cluster, events, workloads,
+                                control_file=control_file,
+                                memory_file=memory_file,
+                                log_path=log_path,
+                                probe_resources=pin)
+        report = runner.run()
+    finally:
+        cluster.shutdown()
+    host_check = {"name": "clean_host", "ok": True, "detail": ""}
+    try:
+        chaos.assert_clean_host(baseline)
+        host_check["detail"] = "no leaked processes/shm"
+    except chaos.HostLeakError as e:
+        host_check["ok"] = False
+        host_check["detail"] = str(e)
+        report["ok"] = False
+        report["violations"].append("clean_host")
+    report["checks"].append(host_check)
+    # Persist the verdict next to the timelines so CI can ship the whole
+    # workdir as one artifact and a failing seed is replayable offline.
+    report_path = os.path.join(workdir, "report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    print()
+    print(cs.render_report(report))
+    print(f"\n  timeline: {plan_path}\n  event log: {log_path}"
+          f"\n  report: {report_path}")
+    return 0 if report["ok"] else 1
+
+
 def cmd_logs(args) -> int:
     """List / tail the per-worker log files each raylet writes under its
     ``session_dir/logs`` (reference: ``ray logs``).  With a file name the
@@ -697,6 +786,30 @@ def main(argv=None) -> int:
     p.add_argument("--log", action="store_true",
                    help="also print the transition log")
     p.set_defaults(fn=cmd_alerts)
+
+    p = sub.add_parser(
+        "chaos", help="seeded compound-fault soak on a disposable local "
+                      "cluster: fault timeline + invariant bank + MTTR "
+                      "report (nonzero exit on any violation)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="schedule seed — same seed, same fault timeline")
+    p.add_argument("--duration", type=float, default=60.0,
+                   help="seconds of fault injection (soak runs longer: "
+                        "quiesce + invariant checks follow)")
+    p.add_argument("--faults", default=",".join(
+        ("node_kill", "partition", "gcs_restart", "drain", "slow_exec")),
+        help="comma-separated fault kinds to draw from")
+    p.add_argument("--nodes", type=int, default=2,
+                   help="worker nodes (= schedule target slots)")
+    p.add_argument("--serve", action="store_true",
+                   help="also run a small Serve app under fire")
+    p.add_argument("--replay", default=None, metavar="JSONL",
+                   help="replay a previously logged timeline instead of "
+                        "building one from --seed")
+    p.add_argument("--workdir", default=None,
+                   help="where timelines/logs/markers go (default: a "
+                        "fresh temp dir)")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
         "logs", help="list/tail per-worker log files (ray logs)")

@@ -54,10 +54,11 @@ Reference analogue: `python/ray/_private/test_utils.py:1400`
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ray_tpu.core.config import config
 from ray_tpu.util.locks import make_lock
@@ -103,7 +104,8 @@ config.define("chaos_net_partition_file", str, "",
               "rewriting the file.  Empty disables.", live=True)
 
 __all__ = ["NodeKiller", "NetworkChaos", "net_fault", "configure_net",
-           "net", "exec_delay"]
+           "net", "exec_delay", "snapshot_host", "assert_clean_host",
+           "HostLeakError"]
 
 
 class NodeKiller:
@@ -168,7 +170,8 @@ class NetworkChaos:
 
     __slots__ = ("enabled", "seed", "drop_p", "delay_p", "delay_s",
                  "blackhole_p", "channels", "_rng", "_lock", "faults",
-                 "partitions", "partition_file", "_pfile_at")
+                 "partitions", "partition_file", "_pfile_at",
+                 "exec_override")
 
     def __init__(self, drop_p: float = 0.0, delay_p: float = 0.0,
                  delay_ms: float = 0.0, blackhole_p: float = 0.0,
@@ -201,6 +204,11 @@ class NetworkChaos:
         self.partitions: dict = {}  # guard: _lock
         self.partition_file = partition_file or None
         self._pfile_at = 0.0  # last control-file refresh  # guard: _lock
+        # control-file slow-exec steering: {"ms", "p", "names"} or None.
+        # Lets a test driver toggle RAY_TPU_CHAOS_EXEC_DELAY_* semantics in
+        # SPAWNED processes (their env is frozen at spawn) by rewriting
+        # the control file — exec_delay() consults this before config.
+        self.exec_override: Optional[dict] = None  # guard: _lock
 
     @classmethod
     def from_env(cls) -> "NetworkChaos":
@@ -259,6 +267,25 @@ class NetworkChaos:
                    "channels": None}
             for peer, direction in entries.items()
         }
+        ov = spec.get("exec_delay")
+        if isinstance(ov, dict) and float(ov.get("ms", 0) or 0) > 0:
+            self.exec_override = {
+                "ms": float(ov["ms"]),
+                "p": float(ov.get("p", 1.0)),
+                "names": str(ov.get("names", "")),
+            }
+        else:
+            self.exec_override = None
+
+    def exec_override_state(self) -> Optional[dict]:
+        """Current control-file slow-exec override ({'ms','p','names'}) or
+        None.  Refreshes the control file on the same 50 ms cadence as the
+        partition state."""
+        if not self.partition_file:
+            return None
+        with self._lock:
+            self._refresh_partitions_locked()
+            return self.exec_override
 
     def _partitioned_locked(self, channel: str, peer: Optional[str],  # requires: _lock
                             direction: str) -> bool:
@@ -337,16 +364,30 @@ def exec_delay(task_name: str) -> float:
     matches all) with probability ``RAY_TPU_CHAOS_EXEC_DELAY_P`` (drawn
     from an RNG seeded by ``RAY_TPU_CHAOS_NET_SEED``, so delay sequences
     replay).  Returns the injected delay in seconds (0 = none).  Live
-    flags: the check costs two env reads per execution when disabled."""
+    flags: the check costs two env reads per execution when disabled.
+
+    When a chaos control file is configured
+    (``RAY_TPU_CHAOS_NET_PARTITION_FILE``), an ``exec_delay`` entry in it
+    overrides the env knobs — the file is re-read live, so a schedule
+    driver can open and close slow-executor windows in already-spawned
+    workers (their env is frozen at spawn)."""
     global _exec_rng
     ms = config.chaos_exec_delay_ms
+    names_csv = config.chaos_exec_delay_names
+    p = config.chaos_exec_delay_p
+    ov = None
+    n = _net
+    if n is not None and n.partition_file:
+        ov = n.exec_override_state()
+    elif n is None and config.chaos_net_partition_file:
+        ov = net().exec_override_state()
+    if ov is not None:
+        ms, p, names_csv = ov["ms"], ov["p"], ov["names"]
     if ms <= 0:
         return 0.0
-    names = [n.strip() for n in config.chaos_exec_delay_names.split(",")
-             if n.strip()]
-    if names and not any(n in task_name for n in names):
+    names = [nm.strip() for nm in names_csv.split(",") if nm.strip()]
+    if names and not any(nm in task_name for nm in names):
         return 0.0
-    p = config.chaos_exec_delay_p
     if p < 1.0:
         with _exec_rng_lock:
             if _exec_rng is None:
@@ -375,3 +416,121 @@ def net_fault(channel: str, peer: Optional[str] = None,
         time.sleep(n.delay_s)
         return None  # the frame still goes out, late
     return fault
+
+
+# ---------------------------------------------------------------------------
+# Clean-host audit: no orphan runtime processes / shm segments / socket fds
+# after a cluster is torn down.  Factored out of the manual verify recipe so
+# cluster-spinning tests fail loudly on leaks instead of leaving them for a
+# human `pgrep` at review time.
+
+# argv module names of every spawnable runtime process.  Matched as EXACT
+# argv elements (``/proc/<pid>/cmdline`` is NUL-separated), never as
+# substrings — test harnesses and editors routinely hold these strings
+# inside one long quoted argument and must not count as runtime orphans.
+_RUNTIME_MODULES = frozenset((
+    "ray_tpu.core.worker_main",
+    "ray_tpu.core.raylet_main",
+    "ray_tpu.core.gcs_main",
+))
+
+
+class HostLeakError(AssertionError):
+    """A runtime process, shm segment, or socket fd outlived its cluster."""
+
+
+def _runtime_pids() -> Dict[int, str]:
+    """pid -> module name for every live runtime process on this host."""
+    out: Dict[int, str] = {}
+    me = os.getpid()
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:  # pragma: no cover — non-Linux
+        return out
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\x00")
+        except OSError:
+            continue  # raced an exit
+        for arg in argv:
+            name = arg.decode("utf-8", "replace")
+            if name in _RUNTIME_MODULES:
+                out[pid] = name
+                break
+    return out
+
+
+def _shm_segments() -> List[str]:
+    """Live ray_tpu object-store segments under /dev/shm."""
+    try:
+        return sorted(n for n in os.listdir("/dev/shm")
+                      if n.startswith("rt_store"))
+    except OSError:  # pragma: no cover — no /dev/shm
+        return []
+
+
+def _socket_fd_count() -> int:
+    """Open socket fds of THIS process (driver-side leak detector)."""
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:  # pragma: no cover — non-Linux
+        return 0
+    n = 0
+    for fd in fds:
+        try:
+            if os.readlink(f"/proc/self/fd/{fd}").startswith("socket:"):
+                n += 1
+        except OSError:
+            continue
+    return n
+
+
+def snapshot_host() -> dict:
+    """Baseline for :func:`assert_clean_host`: take it BEFORE starting a
+    cluster so pre-existing processes/segments (other sessions, the test
+    harness itself) are excluded from the leak check."""
+    return {"pids": _runtime_pids(), "shm": set(_shm_segments()),
+            "socket_fds": _socket_fd_count()}
+
+
+def assert_clean_host(baseline: Optional[dict] = None,
+                      grace_s: float = 15.0,
+                      check_sockets: bool = False):
+    """Assert no runtime process, object-store shm segment, or (opt-in)
+    driver socket fd outlived the cluster(s) torn down since ``baseline``.
+
+    Teardown is asynchronous (workers die on socket EOF, raylets reap on
+    SIGTERM), so the check POLLS up to ``grace_s`` before declaring a
+    leak.  Raises :class:`HostLeakError` listing the survivors.
+
+    ``check_sockets`` compares this process's open socket-fd count to the
+    baseline — off by default because long-lived test fixtures (shared
+    runtimes, metric pollers) legitimately hold sockets across calls.
+    """
+    base_pids = set((baseline or {}).get("pids", {}))
+    base_shm = set((baseline or {}).get("shm", ()))
+    deadline = time.monotonic() + grace_s
+    while True:
+        pids = {p: m for p, m in _runtime_pids().items()
+                if p not in base_pids}
+        shm = [s for s in _shm_segments() if s not in base_shm]
+        leaks = []
+        if pids:
+            leaks.append("orphan processes: " + ", ".join(
+                f"pid {p} ({m})" for p, m in sorted(pids.items())))
+        if shm:
+            leaks.append("leaked shm segments: " + ", ".join(shm))
+        if check_sockets and baseline is not None:
+            extra = _socket_fd_count() - baseline.get("socket_fds", 0)
+            if extra > 0:
+                leaks.append(f"{extra} leaked socket fd(s) in this process")
+        if not leaks:
+            return
+        if time.monotonic() >= deadline:
+            raise HostLeakError(
+                "host not clean after cluster teardown — " +
+                "; ".join(leaks))
+        time.sleep(0.25)

@@ -60,3 +60,15 @@ class BackoffPolicy:
         if self.jitter > 0:
             d *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
         return max(0.0, d)
+
+    def stagger(self, span_s: float) -> float:
+        """A full-range uniform draw in ``[0, span_s]`` — the FIRST-attempt
+        de-synchronizer.  ``delay()``'s +/- jitter keeps retry LOOPS from
+        re-synchronizing, but when many processes start their loops at the
+        same instant (every raylet sees the GCS socket die simultaneously
+        on a restart) a fractional jitter still concentrates the herd
+        around the shared base delay; a full-span draw spreads the initial
+        dials/registrations evenly across the window instead."""
+        if span_s <= 0:
+            return 0.0
+        return self._rng.uniform(0.0, span_s)

@@ -38,6 +38,35 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture
+def clean_host():
+    """Leaked-process audit around cluster-heavy tests: snapshot the
+    host's ray_tpu runtime processes / shm segments before the test,
+    assert everything above the baseline is gone after (teardown is
+    async, so the check polls with a grace window).  Apply per-module
+    with ``pytestmark = pytest.mark.usefixtures("clean_host")``."""
+    from ray_tpu.util import chaos
+
+    baseline = chaos.snapshot_host()
+    yield
+    chaos.assert_clean_host(baseline)
+
+
+@pytest.fixture(scope="module")
+def clean_host_module():
+    """Module-scoped variant of :func:`clean_host` for modules that share
+    ONE live cluster across their tests (e.g. a module-scoped ``cluster``
+    fixture): a per-test audit would flag the shared cluster's warm
+    worker pool — processes that legitimately appear mid-module and
+    outlive individual tests — so the baseline/check pair brackets the
+    whole module instead."""
+    from ray_tpu.util import chaos
+
+    baseline = chaos.snapshot_host()
+    yield
+    chaos.assert_clean_host(baseline)
+
+
+@pytest.fixture
 def ray_start_regular():
     import ray_tpu
 

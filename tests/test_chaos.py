@@ -17,6 +17,10 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.util.chaos import NetworkChaos, NodeKiller
 
+# Every test here spawns real cluster processes — audit for leaked
+# raylets/GCS/shm after each one (conftest.clean_host).
+pytestmark = pytest.mark.usefixtures("clean_host")
+
 
 def _wait_until(predicate, timeout=30.0, interval=0.2, msg="condition"):
     deadline = time.monotonic() + timeout

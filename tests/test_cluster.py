@@ -12,6 +12,12 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+# The module shares ONE live cluster (module-scoped fixture below), whose
+# worker pool legitimately grows mid-module — audit for leaked
+# raylets/GCS/shm once around the whole module, not per test
+# (conftest.clean_host_module).
+pytestmark = pytest.mark.usefixtures("clean_host_module")
+
 
 @pytest.fixture(scope="module")
 def cluster():

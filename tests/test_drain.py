@@ -18,6 +18,10 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.core.gcs import GcsClient
 
+# Every test here spawns real cluster processes — audit for leaked
+# raylets/GCS/shm after each one (conftest.clean_host).
+pytestmark = pytest.mark.usefixtures("clean_host")
+
 
 def _wait(predicate, timeout=30.0, interval=0.2, msg="condition"):
     deadline = time.monotonic() + timeout

@@ -21,6 +21,10 @@ from ray_tpu.core import protocol
 from ray_tpu.core.config import config
 from ray_tpu.core.gcs import GcsCore
 
+# Every test here spawns real cluster processes — audit for leaked
+# raylets/GCS/shm after each one (conftest.clean_host).
+pytestmark = pytest.mark.usefixtures("clean_host")
+
 
 class FakeRaylet:
     """Minimal probe target: answers {"t": "ping"} with a pong carrying

@@ -12,6 +12,10 @@ import pytest
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
+# Every test here spawns real cluster processes — audit for leaked
+# raylets/GCS/shm after each one (conftest.clean_host).
+pytestmark = pytest.mark.usefixtures("clean_host")
+
 
 @pytest.fixture
 def ft_cluster(tmp_path):
@@ -246,3 +250,196 @@ def test_metrics_namespace_is_soft_state(tmp_path):
     assert g2.kv_get("jobs", b"j1") == b"info"
     assert g2.kv_get("metrics", b"pid-1/m") is None
     g2.stop()
+
+
+def test_mass_reconnect_staggers_no_duplicate_registrations(tmp_path):
+    """GCS mass-reconnect thundering herd (regression): every raylet sees
+    the GCS die at the same instant, so without a stagger they all re-dial
+    and re-register in lockstep the moment the port reopens.  After a
+    restart under a multi-raylet cluster:
+
+      (a) every node re-registers exactly once (no duplicate entries, the
+          membership set is unchanged);
+      (b) no registration was fenced (a fenced re-registration means a
+          raylet raced the restart reconciler and got declared dead);
+      (c) re-registrations are STAGGERED — their wall-clock stamps spread
+          across the gcs_reconnect_stagger_s window instead of landing
+          within one lockstep burst."""
+    from ray_tpu.core.gcs import GcsClient
+
+    n_workers = 4
+    stagger_s = 2.0
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"num_cpus": 1},
+        gcs_persist_path=str(tmp_path / "gcs.snapshot"),
+        env={
+            "RAY_TPU_GCS_RECONNECT_TIMEOUT_S": "30",
+            "RAY_TPU_GCS_RECONNECT_STAGGER_S": str(stagger_s),
+        },
+    )
+    try:
+        for _ in range(n_workers):
+            c.add_node(num_cpus=1)
+        c.wait_for_nodes(1 + n_workers)
+
+        cli = GcsClient(c.address)
+        before = {n["node_id"]: n for n in cli.nodes() if n["alive"]}
+        assert len(before) == 1 + n_workers
+        cli.close()
+
+        time.sleep(0.5)  # let the registration snapshot flush
+        c.restart_gcs()
+
+        deadline = time.monotonic() + 30
+        after = {}
+        while time.monotonic() < deadline:
+            try:
+                cli = GcsClient(c.address)
+                rows = cli.nodes()
+                cli.close()
+            except (ConnectionError, OSError):
+                time.sleep(0.3)
+                continue
+            after = {n["node_id"]: n for n in rows if n["alive"]}
+            if len(after) == 1 + n_workers:
+                break
+            time.sleep(0.3)
+
+        # (a) same membership, no duplicates, every incarnation bumped
+        assert set(after) == set(before), \
+            "membership changed across the GCS restart"
+        assert len(rows) == len(after), "duplicate node entries"
+        for nid, info in after.items():
+            assert info["incarnation"] > before[nid]["incarnation"]
+
+        # (b) nothing was fenced during the reconnect storm
+        cli = GcsClient(c.address)
+        hs = cli.health_stats()
+        cli.close()
+        assert hs["fenced_frames_total"] == 0, \
+            f"fenced registrations during mass reconnect: {hs}"
+
+        # (c) staggered: with a 2s full-span stagger, 5 lockstep
+        # registrations landing within 0.2s of each other is ~1e-4
+        # probable by chance — the pre-stagger behavior reproduces it
+        # every run.
+        stamps = sorted(n["registered_at"] for n in after.values())
+        assert stamps[-1] - stamps[0] > 0.2, \
+            f"re-registrations landed in lockstep: spread " \
+            f"{stamps[-1] - stamps[0]:.3f}s"
+
+        # the cluster still works
+        c.connect()
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+    finally:
+        c.shutdown()
+
+
+def test_restart_reconciler_declares_ghost_raylets_dead(tmp_path):
+    """A raylet that dies DURING a GCS outage never re-registers and never
+    trips the suspicion machine (the restarted GCS has no membership row
+    for it) — the reconciler must declare it dead from the persisted
+    incarnation table and PUBLISH node_dead, or peers keep waiting on
+    forwarded work forever (regression: in-flight actor calls to a node
+    killed in the reconnect window hung until the get() deadline)."""
+    from ray_tpu.core.gcs import GcsCore
+
+    path = str(tmp_path / "gcs.snap")
+    g1 = GcsCore(persist_path=path)
+    g1.register_node("ghost", ("127.0.0.1", 1), {"CPU": 2.0})
+    g1.register_node("alive", ("127.0.0.1", 2), {"CPU": 2.0})
+    g1.stop()
+
+    g2 = GcsCore(persist_path=path)
+    events = []
+    g2.subscribe(lambda ev, data: events.append((ev, data)))
+    g2.register_node("alive", ("127.0.0.1", 2), {"CPU": 2.0})
+    g2.start_restart_reconciler(delay=0.3)
+    deadline = time.monotonic() + 5
+    dead = None
+    while time.monotonic() < deadline and dead is None:
+        dead = next((d for ev, d in events
+                     if ev == "node_dead" and d["node_id"] == "ghost"), None)
+        time.sleep(0.05)
+    assert dead is not None, "no node_dead published for the ghost raylet"
+    assert "never reconnected" in dead["reason"]
+    # fenced at its last incarnation: stale frames from a zombie are
+    # rejectable, and a second reconciler pass must not re-declare it
+    assert dead["incarnation"] >= 1
+    assert not any(ev == "node_dead" and d["node_id"] == "alive"
+                   for ev, d in events), "re-registered raylet declared dead"
+    # the survivor's heartbeat is still accepted (not fenced)
+    assert g2.heartbeat("alive", {"CPU": 2.0}) not in (None, "fenced")
+    g2.stop()
+
+
+def test_node_killed_in_reconnect_window_fails_over(tmp_path):
+    """Compound fault: node killed immediately after a GCS restart, before
+    its staggered reconnect re-registers it.  The ghost-death declaration
+    must reach the head raylet so the in-flight actor call raises
+    ActorDiedError (instead of hanging) and the restarted actor serves
+    fresh calls from the replacement node."""
+    c = Cluster(
+        initialize_head=True,
+        head_resources={"num_cpus": 2},
+        gcs_persist_path=str(tmp_path / "gcs.snapshot"),
+        env={
+            "RAY_TPU_GCS_RECONNECT_TIMEOUT_S": "30",
+            "RAY_TPU_GCS_RESTART_RECONCILE_S": "1.5",
+        },
+    )
+    try:
+        # Pin the actor to the (only) node carrying the custom resource so
+        # the kill is guaranteed to hit its host.
+        worker = c.add_node(num_cpus=2, resources={"pin": 1})
+        c.wait_for_nodes(2)
+        c.connect()
+
+        @ray_tpu.remote(resources={"pin": 0.1}, max_restarts=10)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def slow_bump(self):
+                time.sleep(8)
+                self.n += 1
+                return self.n
+
+        a = Counter.remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=15) == 1
+
+        # Genuinely in flight across the compound fault: still executing
+        # on the doomed node when the kill lands.
+        ref = a.slow_bump.remote()
+        c.restart_gcs()
+        c.remove_node(worker)  # killed before its reconnect re-registers
+        c.add_node(num_cpus=2, resources={"pin": 1})
+
+        # The in-flight call must RESOLVE (ActorDiedError) well before the
+        # old behavior's hang-until-deadline; budget covers reconcile
+        # delay + restart.
+        with pytest.raises(ray_tpu.ActorDiedError):
+            ray_tpu.get(ref, timeout=25)
+
+        # and the actor fails over to the replacement node
+        deadline = time.monotonic() + 30
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                val = ray_tpu.get(a.bump.remote(), timeout=10)
+                break
+            except (ray_tpu.GetTimeoutError, ray_tpu.ActorDiedError):
+                time.sleep(0.5)
+        assert val is not None, "actor never recovered on the replacement"
+    finally:
+        c.shutdown()
